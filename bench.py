@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (BASELINE.md): samples/sec/chip on the flagship config. The reference
+publishes no numbers (BASELINE.json "published": {}), so vs_baseline is the
+ratio against the first measured value recorded here.
+
+Currently benches: LeNet-style MNIST config if available, else the MLP slice.
+Runs on the real TPU chip (default jax platform).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def bench_mlp(batch=256, steps=50, warmup=5):
+    import jax
+    from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=1024, activation="relu"))
+            .layer(DenseLayer(n_out=1024, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "MLP-784-1024-1024-10"
+
+
+def main():
+    try:
+        from deeplearning4j_tpu.models import zoo  # noqa: F401
+        has_lenet = hasattr(zoo, "lenet_mnist")
+    except Exception:
+        has_lenet = False
+
+    if has_lenet:
+        from deeplearning4j_tpu.models.zoo import bench_lenet
+        sps, name = bench_lenet()
+    else:
+        sps, name = bench_mlp()
+
+    # First measured value becomes the baseline (reference publishes none).
+    baseline = None
+    try:
+        with open("BENCH_BASELINE.json") as f:
+            baseline = json.load(f).get(name)
+    except Exception:
+        pass
+    vs = sps / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": f"samples/sec/chip ({name})",
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design with the capabilities of
+Deeplearning4j (reference: /root/reference, DL4J 0.8.1-SNAPSHOT): builder
+config DSL with JSON round-trip, Sequential + DAG models over a full layer
+zoo, fit/evaluate with listeners, early stopping, transfer learning,
+checkpoint/resume, gradient-check-first testing, NLP embeddings, DeepWalk,
+t-SNE, Keras import, stats/observability — plus TPU-first capabilities the
+reference lacked: tensor/pipeline/sequence parallelism over device meshes
+with XLA collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .nn import (BackpropType, GradientNormalization, InputType,
+                 MultiLayerConfiguration, MultiLayerNetwork,
+                 NeuralNetConfiguration, NeuralNetConfigurationBuilder,
+                 OptimizationAlgorithm)
+from .nn.layers import (ActivationLayer, DenseLayer, DropoutLayer,
+                        EmbeddingLayer, LossLayer, OutputLayer)
+from .nn.updaters import (AdaDelta, AdaGrad, Adam, AdaMax, Nesterovs, NoOp,
+                          RmsProp, Sgd)
+from .nn.weights import Distribution, WeightInit
+from .datasets import ArrayDataSetIterator, DataSet, DataSetIterator
+from .eval import Evaluation
+from .util import GradientCheckUtil, ModelSerializer
+
+__all__ = [
+    "BackpropType", "GradientNormalization", "InputType",
+    "MultiLayerConfiguration", "MultiLayerNetwork", "NeuralNetConfiguration",
+    "NeuralNetConfigurationBuilder", "OptimizationAlgorithm",
+    "ActivationLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
+    "LossLayer", "OutputLayer",
+    "AdaDelta", "AdaGrad", "Adam", "AdaMax", "Nesterovs", "NoOp", "RmsProp",
+    "Sgd", "Distribution", "WeightInit",
+    "ArrayDataSetIterator", "DataSet", "DataSetIterator", "Evaluation",
+    "GradientCheckUtil", "ModelSerializer",
+]

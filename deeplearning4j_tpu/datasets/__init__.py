@@ -1,0 +1,12 @@
+from .iterators import (
+    DataSet, MultiDataSet, DataSetIterator, ListDataSetIterator,
+    ArrayDataSetIterator, AsyncDataSetIterator, MultipleEpochsIterator,
+    SamplingDataSetIterator, IteratorDataSetIterator, ExistingDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "SamplingDataSetIterator", "IteratorDataSetIterator",
+    "ExistingDataSetIterator",
+]

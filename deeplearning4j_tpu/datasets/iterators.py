@@ -1,0 +1,425 @@
+"""DataSet + iterator API.
+
+Parity with ND4J's `DataSet`/`DataSetIterator` contract as used throughout the
+reference (`datasets/iterator/BaseDatasetIterator.java`,
+`AsyncDataSetIterator.java:33`, `MultipleEpochsIterator`,
+`SamplingDataSetIterator`, `IteratorDataSetIterator`).
+
+TPU-native notes: batches are host numpy until the jitted train step consumes
+them (device transfer happens once per step, overlapped by
+`AsyncDataSetIterator`'s background prefetch thread — same double-buffering
+the reference does on the JVM side).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "SamplingDataSetIterator", "IteratorDataSetIterator",
+    "ExistingDataSetIterator",
+]
+
+
+@dataclass
+class DataSet:
+    """features/labels (+ optional masks) minibatch. Parity with ND4J DataSet
+    (features, labels, featuresMaskArray, labelsMaskArray)."""
+
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        def cut(a, lo, hi):
+            return None if a is None else a[lo:hi]
+        n = self.num_examples()
+        return (DataSet(*(cut(a, 0, n_train) for a in
+                          (self.features, self.labels, self.features_mask, self.labels_mask))),
+                DataSet(*(cut(a, n_train, n) for a in
+                          (self.features, self.labels, self.features_mask, self.labels_mask))))
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        def cat(xs):
+            xs = [x for x in xs if x is not None]
+            return np.concatenate(xs, axis=0) if xs else None
+
+        def cat_masks(masks, anchors):
+            """Concat masks; datasets lacking one get all-ones so rows stay
+            aligned with their examples."""
+            if all(m is None for m in masks):
+                return None
+            proto = next(m for m in masks if m is not None)
+            out = []
+            for m, anchor in zip(masks, anchors):
+                if m is None:
+                    m = np.ones((anchor.shape[0],) + proto.shape[1:],
+                                dtype=proto.dtype)
+                out.append(m)
+            return np.concatenate(out, axis=0)
+
+        feats = [d.features for d in datasets]
+        labs = [d.labels for d in datasets]
+        return DataSet(cat(feats), cat(labs),
+                       cat_masks([d.features_mask for d in datasets], feats),
+                       cat_masks([d.labels_mask for d in datasets],
+                                 [l if l is not None else f
+                                  for l, f in zip(labs, feats)]))
+
+
+@dataclass
+class MultiDataSet:
+    """Multiple-input/multiple-output minibatch (ND4J MultiDataSet), consumed
+    by the ComputationGraph."""
+
+    features: List[np.ndarray] = field(default_factory=list)
+    labels: List[np.ndarray] = field(default_factory=list)
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+class DataSetIterator:
+    """Iterator contract: `__iter__` restarts an epoch (calls `reset`)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def async_supported(self) -> bool:
+        return True
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches over in-memory arrays (role of ND4J's ListDataSetIterator over a
+    pre-split list, but vectorized)."""
+
+    def __init__(self, features, labels=None, batch_size: int = 32,
+                 features_mask=None, labels_mask=None, shuffle: bool = False,
+                 seed: Optional[int] = None, drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self.reset()
+
+    def reset(self):
+        n = self.features.shape[0]
+        if self.shuffle:
+            rng = np.random.default_rng(
+                None if self.seed is None else self.seed + self._epoch)
+            self._order = rng.permutation(n)
+        else:
+            self._order = np.arange(n)
+        self._pos = 0
+        self._epoch += 1
+
+    def has_next(self) -> bool:
+        remaining = len(self._order) - self._pos
+        if self.drop_last:
+            return remaining >= self.batch_size
+        return remaining > 0
+
+    def next(self) -> DataSet:
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += len(idx)
+
+        def take(a):
+            return None if a is None else a[idx]
+        return DataSet(take(self.features), take(self.labels),
+                       take(self.features_mask), take(self.labels_mask))
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return int(self.features.shape[0])
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterates a list of pre-built DataSets, re-batched to `batch` examples
+    (parity with `datasets/iterator/ListDataSetIterator`)."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        self._datasets = list(datasets)
+        self._batch = batch_size
+        if batch_size is not None:
+            merged = DataSet.merge(self._datasets)
+            self._datasets = []
+            for i in range(0, merged.num_examples(), batch_size):
+                self._datasets.append(DataSet(
+                    merged.features[i:i + batch_size],
+                    None if merged.labels is None else merged.labels[i:i + batch_size],
+                    None if merged.features_mask is None else merged.features_mask[i:i + batch_size],
+                    None if merged.labels_mask is None else merged.labels_mask[i:i + batch_size]))
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._datasets)
+
+    def next(self):
+        d = self._datasets[self._pos]
+        self._pos += 1
+        return d
+
+    def batch(self):
+        return self._batch or (self._datasets[0].num_examples() if self._datasets else 0)
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps a plain python iterable of DataSets
+    (`datasets/iterator/ExistingDataSetIterator.java`)."""
+
+    def __init__(self, iterable: Iterable[DataSet]):
+        self._iterable = iterable
+        self.reset()
+
+    def reset(self):
+        self._it = iter(self._iterable)
+        self._peek = None
+        self._advance()
+
+    def _advance(self):
+        try:
+            self._peek = next(self._it)
+        except StopIteration:
+            self._peek = None
+
+    def has_next(self):
+        return self._peek is not None
+
+    def next(self):
+        d = self._peek
+        self._advance()
+        return d
+
+    def batch(self):
+        return -1
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batches an iterator of DataSets to a fixed minibatch size
+    (`datasets/iterator/IteratorDataSetIterator.java`)."""
+
+    def __init__(self, source: DataSetIterator, batch_size: int):
+        self.source = source
+        self.batch_size = int(batch_size)
+        self._buffer: List[DataSet] = []
+
+    def reset(self):
+        self.source.reset()
+        self._buffer = []
+
+    def has_next(self):
+        return bool(self._buffer) or self.source.has_next()
+
+    def next(self):
+        have = sum(d.num_examples() for d in self._buffer)
+        while have < self.batch_size and self.source.has_next():
+            d = self.source.next()
+            self._buffer.append(d)
+            have += d.num_examples()
+        merged = DataSet.merge(self._buffer)
+
+        def cut(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        b = self.batch_size
+        out = DataSet(cut(merged.features, 0, b), cut(merged.labels, 0, b),
+                      cut(merged.features_mask, 0, b),
+                      cut(merged.labels_mask, 0, b))
+        n = merged.num_examples()
+        self._buffer = []
+        if n > b:
+            self._buffer = [DataSet(cut(merged.features, b, n),
+                                    cut(merged.labels, b, n),
+                                    cut(merged.features_mask, b, n),
+                                    cut(merged.labels_mask, b, n))]
+        return out
+
+    def batch(self):
+        return self.batch_size
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays an iterator for N epochs (`datasets/iterator/MultipleEpochsIterator.java`)."""
+
+    def __init__(self, epochs: int, source: DataSetIterator):
+        self.epochs = int(epochs)
+        self.source = source
+        self._epoch = 0
+
+    def reset(self):
+        self.source.reset()
+        self._epoch = 0
+
+    def has_next(self):
+        if self.source.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.source.reset()
+            return self.source.has_next()
+        return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.source.next()
+
+    def batch(self):
+        return self.source.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Samples minibatches with replacement from one DataSet
+    (`datasets/iterator/SamplingDataSetIterator.java`)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, total_batches: int,
+                 seed: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.total_batches = int(total_batches)
+        self.seed = seed
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self.total_batches
+
+    def next(self):
+        idx = self._rng.integers(0, self.dataset.num_examples(), self.batch_size)
+        self._count += 1
+        return DataSet(self.dataset.features[idx],
+                       None if self.dataset.labels is None else self.dataset.labels[idx])
+
+    def batch(self):
+        return self.batch_size
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (double buffering) — parity with
+    `datasets/iterator/AsyncDataSetIterator.java:33`, including worker-exception
+    propagation to the caller."""
+
+    _SENTINEL = object()
+
+    def __init__(self, source: DataSetIterator, queue_size: int = 2):
+        self.source = source
+        self.queue_size = max(1, int(queue_size))
+        self._queue: queue.Queue = queue.Queue(self.queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._peek = None
+        self._start()
+
+    def _start(self):
+        self._queue = queue.Queue(self.queue_size)
+        self._error = None
+        self._stop = threading.Event()
+        # Bind this generation's queue/stop locally: a stale worker that
+        # outlives reset()'s join timeout must keep writing to ITS queue, not
+        # the new generation's (else previous-epoch batches leak in).
+        q, stop = self._queue, self._stop
+
+        def worker():
+            try:
+                while self.source.has_next() and not stop.is_set():
+                    q.put(self.source.next())
+            except BaseException as e:  # propagate to consumer
+                self._error = e
+            finally:
+                q.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._peek = None
+        self._fetch()
+
+    def _fetch(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise RuntimeError("Async prefetch thread failed") from self._error
+            self._peek = None
+        else:
+            self._peek = item
+
+    def reset(self):
+        self._stop.set()
+        # drain so the worker unblocks, then restart
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.source.reset()
+        self._start()
+
+    def has_next(self):
+        return self._peek is not None
+
+    def next(self):
+        d = self._peek
+        if d is None:
+            raise StopIteration
+        self._fetch()
+        return d
+
+    def batch(self):
+        return self.source.batch()

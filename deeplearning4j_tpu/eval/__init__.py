@@ -1,0 +1,3 @@
+from .evaluation import Evaluation, ConfusionMatrix
+
+__all__ = ["Evaluation", "ConfusionMatrix"]

@@ -1,0 +1,13 @@
+from . import activations, losses, schedules, updaters, weights
+from .conf import (BackpropType, GradientNormalization, InputType,
+                   MultiLayerConfiguration, NeuralNetConfiguration,
+                   NeuralNetConfigurationBuilder, OptimizationAlgorithm)
+from .multilayer import MultiLayerNetwork
+
+__all__ = [
+    "activations", "losses", "schedules", "updaters", "weights",
+    "BackpropType", "GradientNormalization", "InputType",
+    "MultiLayerConfiguration", "NeuralNetConfiguration",
+    "NeuralNetConfigurationBuilder", "OptimizationAlgorithm",
+    "MultiLayerNetwork",
+]

@@ -1,0 +1,139 @@
+"""Activation functions.
+
+Capability parity with the reference's `IActivation` surface (ND4J activations
+used throughout `deeplearning4j-nn`, selected by name in layer builders, e.g.
+`nn/conf/layers/Layer.java` activation field). All functions are pure
+`jnp -> jnp` maps so XLA can fuse them into adjacent matmuls/convs — the
+TPU-native replacement for ND4J's per-op native kernels.
+
+Backward passes come from `jax.grad`; no hand-written derivatives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "ACTIVATIONS"]
+
+
+def _identity(x):
+    return x
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+def _relu(x):
+    return jax.nn.relu(x)
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def _elu(x):
+    return jax.nn.elu(x)
+
+
+def _selu(x):
+    return jax.nn.selu(x)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+def _softmax(x):
+    # Applied over the feature axis (last axis); DL4J applies softmax row-wise
+    # on [batch, classes] activations.
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def _softplus(x):
+    return jax.nn.softplus(x)
+
+
+def _softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _rationaltanh(x):
+    # Reference: ND4J ActivationRationalTanh — fast tanh approximation
+    # 1.7159 * tanh_approx(2x/3) with |x| clipped rational approximation.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + a + a ** 2 + 1.41645 * a ** 4))
+    return 1.7159 * approx
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _swish(x):
+    return jax.nn.silu(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def _threshold_relu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS = {
+    "identity": _identity,
+    "linear": _identity,
+    "sigmoid": _sigmoid,
+    "tanh": _tanh,
+    "relu": _relu,
+    "leakyrelu": _leakyrelu,
+    "elu": _elu,
+    "selu": _selu,
+    "gelu": _gelu,
+    "softmax": _softmax,
+    "logsoftmax": _logsoftmax,
+    "softplus": _softplus,
+    "softsign": _softsign,
+    "hardtanh": _hardtanh,
+    "hardsigmoid": _hardsigmoid,
+    "cube": _cube,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "swish": _swish,
+    "mish": _mish,
+    "thresholdedrelu": _threshold_relu,
+}
+
+
+def get(name):
+    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

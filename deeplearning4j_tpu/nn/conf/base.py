@@ -1,0 +1,241 @@
+"""Layer config/implementation base + registry + JSON serde.
+
+Design departure from the reference: DL4J splits every layer into a config
+class (`nn/conf/layers/*.java`), a param initializer (`nn/params/*.java`) and an
+implementation (`nn/layers/**`), wired by reflection. TPU-native, a layer is a
+single dataclass that is simultaneously:
+
+  * serializable hyperparameter record (JSON round-trip, like the reference's
+    Jackson configs — `nn/conf/NeuralNetConfiguration.java:73`),
+  * param initializer (`init_params(rng, input_type)` — replaces
+    `nn/api/ParamInitializer.java`; params are a dict pytree, not views into a
+    flattened buffer),
+  * pure apply function (`apply(params, state, x, train, rng, mask)`) whose
+    backward pass is derived by `jax.grad` (replaces every hand-written
+    `backpropGradient`, e.g. `nn/layers/BaseLayer.java`).
+
+`state` carries non-trained per-layer arrays (BatchNorm running stats —
+reference keeps these as params with noop updaters).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .input_type import InputType
+from .. import activations as _activations
+from .. import updaters as _updaters
+from ..weights import Distribution, WeightInit, init_weight
+
+__all__ = [
+    "LayerConf", "register_layer", "layer_from_dict", "conf_to_dict",
+    "conf_from_dict", "LAYER_REGISTRY", "MaskState",
+]
+
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+class MaskState:
+    """Parity with `nn/api/MaskState.java` — Active vs Passthrough."""
+
+    ACTIVE = "active"
+    PASSTHROUGH = "passthrough"
+
+
+def register_layer(cls):
+    """Class decorator: registers a layer config under its class name for the
+    JSON round-trip (role of Jackson's @JsonTypeInfo in the reference)."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# Generic dataclass <-> dict serde (handles nested special types)
+# ---------------------------------------------------------------------------
+
+def conf_to_dict(obj: Any) -> Any:
+    from ..schedules import Schedule
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, _updaters.Updater):
+        return {"__updater__": obj.to_dict()}
+    if isinstance(obj, Distribution):
+        return {"__distribution__": obj.to_dict()}
+    if isinstance(obj, Schedule):
+        return {"__schedule__": obj.to_dict()}
+    if isinstance(obj, InputType):
+        return {"__input_type__": obj.to_dict()}
+    if isinstance(obj, LayerConf):
+        return {"__layer__": {"type": type(obj).__name__,
+                              "fields": {f.name: conf_to_dict(getattr(obj, f.name))
+                                         for f in dataclasses.fields(obj)}}}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {"__dataclass__": {"type": type(obj).__name__,
+                                  "fields": {f.name: conf_to_dict(getattr(obj, f.name))
+                                             for f in dataclasses.fields(obj)}}}
+    if isinstance(obj, (list, tuple)):
+        return [conf_to_dict(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): conf_to_dict(v) for k, v in obj.items()}
+    raise TypeError(f"Cannot serialize config value of type {type(obj)}: {obj!r}")
+
+
+_AUX_DATACLASSES: Dict[str, type] = {}
+
+
+def register_aux_dataclass(cls):
+    """Register a plain dataclass (non-layer) used inside configs, e.g. VAE
+    reconstruction distributions."""
+    _AUX_DATACLASSES[cls.__name__] = cls
+    return cls
+
+
+def conf_from_dict(obj: Any) -> Any:
+    from ..schedules import Schedule
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, list):
+        return [conf_from_dict(x) for x in obj]
+    if isinstance(obj, dict):
+        if "__updater__" in obj:
+            return _updaters.from_dict(obj["__updater__"])
+        if "__distribution__" in obj:
+            return Distribution.from_dict(obj["__distribution__"])
+        if "__schedule__" in obj:
+            return Schedule.from_dict(obj["__schedule__"])
+        if "__input_type__" in obj:
+            return InputType.from_dict(obj["__input_type__"])
+        if "__layer__" in obj:
+            spec = obj["__layer__"]
+            cls = LAYER_REGISTRY.get(spec["type"])
+            if cls is None:
+                raise ValueError(f"Unknown layer type '{spec['type']}' in config")
+            fields = {k: conf_from_dict(v) for k, v in spec["fields"].items()}
+            known = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in fields.items() if k in known})
+        if "__dataclass__" in obj:
+            spec = obj["__dataclass__"]
+            cls = _AUX_DATACLASSES.get(spec["type"])
+            if cls is None:
+                raise ValueError(f"Unknown aux dataclass '{spec['type']}' in config")
+            fields = {k: conf_from_dict(v) for k, v in spec["fields"].items()}
+            return cls(**fields)
+        return {k: conf_from_dict(v) for k, v in obj.items()}
+    raise TypeError(f"Cannot deserialize config value {obj!r}")
+
+
+def layer_from_dict(d: Dict) -> "LayerConf":
+    out = conf_from_dict(d if "__layer__" in d else {"__layer__": d})
+    if not isinstance(out, LayerConf):
+        raise ValueError("not a layer dict")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Layer base
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LayerConf:
+    """Base hyperparameters shared by all layers (reference:
+    `nn/conf/layers/Layer.java` + `BaseLayer` config fields).
+
+    Inheritable fields left as None inherit the global value from
+    `NeuralNetConfiguration` at build time (reference behavior: per-layer
+    overrides of lr/updater/regularization)."""
+
+    # expected input family for preprocessor inference: "ff"|"cnn"|"rnn"|"any"
+    input_kind = "ff"
+
+    name: Optional[str] = None
+    activation: Optional[str] = None          # activation fn name
+    weight_init: Optional[str] = None         # WeightInit scheme
+    dist: Optional[Distribution] = None       # for WeightInit.DISTRIBUTION
+    bias_init: Optional[float] = None
+    updater: Optional[_updaters.Updater] = None   # per-layer updater override
+    learning_rate: Optional[float] = None     # per-layer lr override
+    bias_learning_rate: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None           # input retain probability (inverted dropout)
+    dtype: Optional[str] = None               # param dtype override ("float32"/"bfloat16")
+    frozen: bool = False                      # transfer learning: exclude from updates
+    gradient_normalization: Optional[str] = None   # see GradientNormalization
+    gradient_normalization_threshold: Optional[float] = None
+
+    # ---- shape inference -------------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def n_in_from(self, input_type: InputType) -> int:
+        return input_type.flat_size()
+
+    # ---- params ----------------------------------------------------------
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def init_params(self, rng, input_type: InputType) -> Dict[str, jax.Array]:
+        return {}
+
+    def init_state(self, input_type: InputType) -> Dict[str, jax.Array]:
+        return {}
+
+    # ---- forward ---------------------------------------------------------
+    def apply(self, params, state, x, *, train: bool = False, rng=None,
+              mask=None):
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- regularization contribution ------------------------------------
+    def reg_score(self, params) -> jax.Array:
+        """L1/L2 penalty for this layer's params (weights vs biases split, as
+        the reference's `calcL1/calcL2` on BaseLayer)."""
+        score = jnp.float32(0.0)
+        for k, v in params.items():
+            is_bias = k == "b" or k.endswith("_b") or "bias" in k
+            l1 = (self.l1_bias if is_bias else self.l1) or 0.0
+            l2 = (self.l2_bias if is_bias else self.l2) or 0.0
+            if l1:
+                score = score + l1 * jnp.sum(jnp.abs(v))
+            if l2:
+                score = score + 0.5 * l2 * jnp.sum(v * v)
+        return score
+
+    # ---- helpers ---------------------------------------------------------
+    def _act(self, x):
+        return _activations.get(self.activation or "identity")(x)
+
+    def _winit(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.dtype:
+            dtype = jnp.dtype(self.dtype)
+        return init_weight(rng, shape, self.weight_init or WeightInit.XAVIER,
+                           fan_in=fan_in, fan_out=fan_out,
+                           distribution=self.dist, dtype=dtype)
+
+    def _binit(self, shape, dtype=jnp.float32):
+        if self.dtype:
+            dtype = jnp.dtype(self.dtype)
+        return jnp.full(shape, self.bias_init or 0.0, dtype)
+
+    def maybe_dropout_input(self, x, train, rng):
+        """Reference semantics: layer.dropOut applies dropout to the layer
+        *input* during training (`util/Dropout.java`), inverted scaling."""
+        if not train or not self.dropout or self.dropout >= 1.0 or rng is None:
+            return x
+        keep = self.dropout
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0)
+
+    def to_dict(self):
+        return conf_to_dict(self)
+
+    def clone_with(self, **overrides) -> "LayerConf":
+        return dataclasses.replace(self, **overrides)

@@ -1,0 +1,98 @@
+"""Input types for shape inference.
+
+Parity with `nn/conf/inputs/InputType.java:40` (feedForward:60, recurrent:68,
+convolutional:79, convolutionalFlat:92). Layer configs use these to infer
+`n_in` from the previous layer's output type — the same role
+`MultiLayerConfiguration.Builder.setInputType` plays in the reference.
+
+Convolutional data layout is **NHWC** (TPU-native; XLA's preferred conv layout)
+rather than the reference's NCHW. The preprocessors handle flattening order
+compatibility where it is user-observable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+__all__ = ["InputType"]
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn1d"
+    size: int = 0                      # ff: feature count; rnn: features per step
+    timesteps: Optional[int] = None    # rnn/cnn1d: series length (None = variable)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    # --- factories (mirror InputType.java static methods) -----------------
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=int(size),
+                         timesteps=None if timesteps is None else int(timesteps))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        it = InputType(kind="cnn_flat", height=int(height), width=int(width),
+                       channels=int(channels),
+                       size=int(height) * int(width) * int(channels))
+        return it
+
+    @staticmethod
+    def convolutional1d(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="cnn1d", size=int(size),
+                         timesteps=None if timesteps is None else int(timesteps))
+
+    # --- helpers ----------------------------------------------------------
+    def flat_size(self) -> int:
+        if self.kind in ("ff", "cnn_flat"):
+            return self.size if self.kind == "ff" else self.height * self.width * self.channels
+        if self.kind == "cnn":
+            return self.height * self.width * self.channels
+        if self.kind in ("rnn", "cnn1d"):
+            return self.size
+        raise ValueError(f"no flat size for {self}")
+
+    def batch_shape(self, batch: int = 1) -> Tuple[int, ...]:
+        """Example array shape (batch leading). CNN is NHWC; RNN is [B, T, F]."""
+        if self.kind == "ff":
+            return (batch, self.size)
+        if self.kind == "cnn_flat":
+            return (batch, self.height * self.width * self.channels)
+        if self.kind == "cnn":
+            return (batch, self.height, self.width, self.channels)
+        if self.kind in ("rnn", "cnn1d"):
+            t = self.timesteps if self.timesteps is not None else 1
+            return (batch, t, self.size)
+        raise ValueError(f"unknown InputType kind {self.kind}")
+
+    def to_dict(self):
+        return {"kind": self.kind, "size": self.size, "timesteps": self.timesteps,
+                "height": self.height, "width": self.width, "channels": self.channels}
+
+    @staticmethod
+    def from_dict(d) -> "InputType":
+        return InputType(**d)
+
+    def __repr__(self):
+        if self.kind == "ff":
+            return f"InputType.feed_forward({self.size})"
+        if self.kind == "rnn":
+            return f"InputType.recurrent({self.size}, timesteps={self.timesteps})"
+        if self.kind == "cnn":
+            return f"InputType.convolutional({self.height},{self.width},{self.channels})"
+        if self.kind == "cnn_flat":
+            return f"InputType.convolutional_flat({self.height},{self.width},{self.channels})"
+        if self.kind == "cnn1d":
+            return f"InputType.convolutional1d({self.size}, timesteps={self.timesteps})"
+        return f"InputType({self.kind})"

@@ -1,0 +1,45 @@
+"""Per-layer gradient normalization/clipping.
+
+Parity with `nn/conf/GradientNormalization.java` as applied by
+`nn/updater/LayerUpdater.java` (preApply): renormalize-L2 (per layer / per
+param type), elementwise clip, L2-norm clip (per layer / per param type).
+Pure pytree transforms, fused into the jitted train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .conf import GradientNormalization
+
+__all__ = ["apply_gradient_normalization"]
+
+
+def _global_l2(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-30)
+
+
+def apply_gradient_normalization(mode: str, threshold: float, grads):
+    """grads: one layer's param dict (pytree). Returns transformed grads."""
+    if mode in (None, GradientNormalization.NONE):
+        return grads
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        norm = _global_l2(grads)
+        return jax.tree_util.tree_map(lambda g: g / norm, grads)
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return jax.tree_util.tree_map(
+            lambda g: g / jnp.sqrt(jnp.sum(g * g) + 1e-30), grads)
+    if mode == GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE:
+        t = threshold
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t), grads)
+    if mode == GradientNormalization.CLIP_L2_PER_LAYER:
+        norm = _global_l2(grads)
+        scale = jnp.minimum(1.0, threshold / norm)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        def clip(g):
+            norm = jnp.sqrt(jnp.sum(g * g) + 1e-30)
+            return g * jnp.minimum(1.0, threshold / norm)
+        return jax.tree_util.tree_map(clip, grads)
+    raise ValueError(f"Unknown gradient normalization '{mode}'")

@@ -1,0 +1,10 @@
+"""Layer zoo. Config+impl unified dataclasses (see `nn/conf/base.py`)."""
+from .feedforward import (
+    DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
+    EmbeddingLayer, BaseOutputLayerConf,
+)
+
+__all__ = [
+    "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
+    "DropoutLayer", "EmbeddingLayer", "BaseOutputLayerConf",
+]

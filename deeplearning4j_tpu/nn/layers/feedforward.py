@@ -1,0 +1,202 @@
+"""Feed-forward layers: Dense, Output/Loss, Activation, Dropout, Embedding.
+
+Reference parity:
+  * DenseLayer — `nn/conf/layers/DenseLayer.java` + `nn/layers/feedforward/dense/DenseLayer.java`
+  * OutputLayer — `nn/conf/layers/OutputLayer.java` + `nn/layers/OutputLayer.java`
+  * LossLayer — `nn/conf/layers/LossLayer.java` (no params, loss only)
+  * ActivationLayer — `nn/conf/layers/ActivationLayer.java`
+  * DropoutLayer — `nn/conf/layers/DropoutLayer.java`
+  * EmbeddingLayer — `nn/conf/layers/EmbeddingLayer.java` (+ feedforward/embedding impl)
+
+All matmuls hit the MXU via `jnp.dot`; activations fuse in XLA. Backward is
+`jax.grad` — the hand-written `backpropGradient` methods have no analog here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import losses as _losses
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+
+__all__ = [
+    "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
+    "DropoutLayer", "EmbeddingLayer", "BaseOutputLayerConf",
+]
+
+
+@register_layer
+@dataclass
+class DenseLayer(LayerConf):
+    """Fully connected layer: y = act(x @ W + b). W: [n_in, n_out]."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, input_type: InputType):
+        n_in = self.n_in or input_type.flat_size()
+        w = self._winit(rng, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@dataclass
+class BaseOutputLayerConf(LayerConf):
+    """Shared machinery for loss-bearing layers (reference:
+    `nn/conf/layers/BaseOutputLayer.java`). The network calls `preout` to get
+    logits and `loss_score` for the (fused, stable) loss; `apply` gives
+    inference-time activations."""
+
+    loss: str = "mcxent"
+    loss_weights: Optional[list] = None
+
+    def loss_fn(self):
+        return _losses.get(self.loss)
+
+    def preout(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = self.preout(params, state, x, train=train, rng=rng, mask=mask)
+        return self._act(z), state
+
+    def loss_score(self, params, state, x, labels, *, train=False, rng=None,
+                   mask=None):
+        """Mean per-example loss computed from logits (fused path)."""
+        z = self.preout(params, state, x, train=train, rng=rng, mask=mask)
+        if z.ndim == 3:
+            # time-series logits [B, T, F]: flatten handled by the loss's mask path
+            pass
+        return self.loss_fn().score(labels, z, activation=self.activation,
+                                    mask=mask, weights=self.loss_weights)
+
+
+@register_layer
+@dataclass
+class OutputLayer(BaseOutputLayerConf):
+    """Dense + loss head (reference OutputLayer extends FeedForwardLayer)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "softmax"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, input_type: InputType):
+        n_in = self.n_in or input_type.flat_size()
+        p = {"W": self._winit(rng, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def preout(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+
+@register_layer
+@dataclass
+class LossLayer(BaseOutputLayerConf):
+    """Parameter-free loss head (reference `nn/conf/layers/LossLayer.java`)."""
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+
+
+@register_layer
+@dataclass
+class ActivationLayer(LayerConf):
+    """Applies an activation only (reference `nn/conf/layers/ActivationLayer.java`)."""
+
+    input_kind = "any"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self._act(x), state
+
+
+@register_layer
+@dataclass
+class DropoutLayer(LayerConf):
+    """Standalone dropout layer (reference `nn/conf/layers/DropoutLayer.java`).
+    `dropout` field = retain probability, inverted scaling at train time."""
+
+    input_kind = "any"
+
+    def __post_init__(self):
+        if self.dropout is None:
+            self.dropout = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.maybe_dropout_input(x, train, rng), state
+
+
+@register_layer
+@dataclass
+class EmbeddingLayer(LayerConf):
+    """Index -> vector lookup (reference `nn/conf/layers/EmbeddingLayer.java`):
+    input is int class indices [B] or one-hot-ish [B,1]; output [B, n_out].
+    Lookup is a gather — XLA lowers to an efficient dynamic-slice; the scatter
+    in the backward pass only touches used rows (sparse-gradient behavior the
+    reference gets from its custom embedding backprop)."""
+
+    n_in: int = 0   # vocab size
+    n_out: int = 0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, input_type: InputType):
+        p = {"W": self._winit(rng, (self.n_in, self.n_out),
+                              fan_in=self.n_in, fan_out=self.n_out)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        idx = idx.astype(jnp.int32)
+        z = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state

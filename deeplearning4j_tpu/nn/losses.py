@@ -1,0 +1,198 @@
+"""Loss functions.
+
+Capability parity with ND4J's `ILossFunction` implementations as consumed by the
+reference's output layers (`nn/conf/layers/OutputLayer.java`,
+`nn/layers/BaseOutputLayer`). Every loss is a pure function
+
+    loss(labels, preactivations_or_activations, activation_fn, mask) -> scalar
+
+returning the mean per-example score, with optional per-element label weights
+and per-timestep masks (the reference's masked scoring path is
+`util/MaskedReductionUtil.java`). Gradients flow through `jax.grad` — no
+hand-coded `computeGradient` like ND4J's loss classes.
+
+Numerically-fused paths: `mcxent` + softmax and `xent` + sigmoid are computed
+from logits with log-sum-exp / log-sigmoid so XLA sees the fused stable form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "LOSSES", "Loss"]
+
+_EPS = 1e-7
+
+
+def _apply_mask(per_example, mask):
+    """per_example: [batch, ...] already reduced over features -> [batch] or
+    [batch, time]. Mask broadcasts over it; returns masked mean."""
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = mask.astype(per_example.dtype)
+    mask = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (per_example.ndim - mask.ndim)), per_example.shape)
+    total = jnp.sum(per_example * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+class Loss:
+    """A named loss. `score(labels, logits, activation, mask, weights)` returns the
+    scalar mean score; `per_example` returns the unreduced [batch,...] scores."""
+
+    def __init__(self, name, fn, fused_with=None):
+        self.name = name
+        self._fn = fn
+        # activation name this loss fuses with when computed from logits
+        self.fused_with = fused_with
+
+    def per_example(self, labels, logits, activation=None, weights=None):
+        return self._fn(labels, logits, activation, weights)
+
+    def score(self, labels, logits, activation=None, mask=None, weights=None):
+        return _apply_mask(self.per_example(labels, logits, activation, weights), mask)
+
+    def __repr__(self):
+        return f"Loss({self.name})"
+
+
+def _activate(logits, activation):
+    from . import activations
+
+    if activation is None:
+        return logits
+    return activations.get(activation)(logits)
+
+
+def _wsum(per_elem, weights):
+    """Reduce feature axis with optional per-class weights."""
+    if weights is not None:
+        per_elem = per_elem * jnp.asarray(weights, dtype=per_elem.dtype)
+    return jnp.sum(per_elem, axis=-1)
+
+
+def _mse(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    return _wsum((out - labels) ** 2, weights) / labels.shape[-1]
+
+
+def _l2(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    return _wsum((out - labels) ** 2, weights)
+
+
+def _mae(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    return _wsum(jnp.abs(out - labels), weights) / labels.shape[-1]
+
+
+def _l1(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    return _wsum(jnp.abs(out - labels), weights)
+
+
+def _mcxent(labels, logits, activation, weights):
+    # Multi-class cross entropy. When paired with softmax we fuse from logits
+    # (stable log_softmax); with any other activation we take log of outputs.
+    act_name = str(activation).lower() if activation is not None else None
+    if act_name in (None, "softmax"):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        out = _activate(logits, activation)
+        logp = jnp.log(jnp.clip(out, _EPS, 1.0))
+    return -_wsum(labels * logp, weights)
+
+
+def _xent(labels, logits, activation, weights):
+    # Binary cross entropy per output unit. Fused sigmoid path from logits.
+    act_name = str(activation).lower() if activation is not None else None
+    if act_name in (None, "sigmoid"):
+        logp = jax.nn.log_sigmoid(logits)
+        lognotp = jax.nn.log_sigmoid(-logits)
+    else:
+        out = jnp.clip(_activate(logits, activation), _EPS, 1.0 - _EPS)
+        logp, lognotp = jnp.log(out), jnp.log1p(-out)
+    return -_wsum(labels * logp + (1.0 - labels) * lognotp, weights)
+
+
+def _nll(labels, logits, activation, weights):
+    # Reference treats NEGATIVELOGLIKELIHOOD as MCXENT (LossNegativeLogLikelihood
+    # extends LossMCXENT in ND4J).
+    return _mcxent(labels, logits, activation, weights)
+
+
+def _hinge(labels, logits, activation, weights):
+    # labels in {-1, +1} (DL4J converts {0,1} labels; we accept both)
+    out = _activate(logits, activation)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    return _wsum(jnp.maximum(0.0, 1.0 - y * out), weights)
+
+
+def _squared_hinge(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    return _wsum(jnp.maximum(0.0, 1.0 - y * out) ** 2, weights)
+
+
+def _kld(labels, logits, activation, weights):
+    out = jnp.clip(_activate(logits, activation), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    return _wsum(lab * (jnp.log(lab) - jnp.log(out)), weights)
+
+
+def _poisson(labels, logits, activation, weights):
+    out = jnp.clip(_activate(logits, activation), _EPS, None)
+    return _wsum(out - labels * jnp.log(out), weights)
+
+
+def _cosine_proximity(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+    on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    cos = jnp.sum(labels * out, axis=-1) / jnp.squeeze(
+        jnp.maximum(ln * on, _EPS), -1
+    )
+    return -cos
+
+
+def _mape(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    return _wsum(jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), _EPS, None)), weights) * (
+        100.0 / labels.shape[-1]
+    )
+
+
+def _msle(labels, logits, activation, weights):
+    out = _activate(logits, activation)
+    d = jnp.log1p(jnp.clip(out, -1 + _EPS, None)) - jnp.log1p(jnp.clip(labels, -1 + _EPS, None))
+    return _wsum(d ** 2, weights) / labels.shape[-1]
+
+
+LOSSES = {
+    "mse": Loss("mse", _mse),
+    "l2": Loss("l2", _l2),
+    "mae": Loss("mae", _mae),
+    "l1": Loss("l1", _l1),
+    "mcxent": Loss("mcxent", _mcxent, fused_with="softmax"),
+    "xent": Loss("xent", _xent, fused_with="sigmoid"),
+    "negativeloglikelihood": Loss("negativeloglikelihood", _nll, fused_with="softmax"),
+    "hinge": Loss("hinge", _hinge),
+    "squared_hinge": Loss("squared_hinge", _squared_hinge),
+    "kl_divergence": Loss("kl_divergence", _kld),
+    "poisson": Loss("poisson", _poisson),
+    "cosine_proximity": Loss("cosine_proximity", _cosine_proximity),
+    "mape": Loss("mape", _mape),
+    "msle": Loss("msle", _msle),
+}
+# Aliases matching the reference's LossFunctions.LossFunction enum names
+LOSSES["squared_loss"] = LOSSES["l2"]
+LOSSES["reconstruction_crossentropy"] = LOSSES["xent"]
+
+
+def get(name):
+    if isinstance(name, Loss):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(LOSSES)}")
+    return LOSSES[key]

@@ -1,0 +1,95 @@
+"""Learning-rate schedules.
+
+Parity with the reference's `nn/conf/LearningRatePolicy.java` (None, Exponential,
+Inverse, Poly, Sigmoid, Step, Schedule, Score, TorchStep) expressed as pure
+`step -> multiplier/lr` functions usable inside jit (static python branching is
+resolved at trace time; step math is jnp so it traces cleanly).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["LearningRatePolicy", "Schedule", "make_schedule"]
+
+
+class LearningRatePolicy:
+    NONE = "none"
+    EXPONENTIAL = "exponential"
+    INVERSE = "inverse"
+    POLY = "poly"
+    SIGMOID = "sigmoid"
+    STEP = "step"
+    SCHEDULE = "schedule"
+    TORCH_STEP = "torchstep"
+    # SCORE policy (decay on plateau) is handled host-side by the Solver, not here.
+    SCORE = "score"
+
+
+@dataclass
+class Schedule:
+    """Computes lr(step) from a base lr and a policy.
+
+    Fields mirror NeuralNetConfiguration's lrPolicy* settings:
+    decay_rate ~ lrPolicyDecayRate, steps ~ lrPolicySteps, power ~ lrPolicyPower.
+    """
+
+    base_lr: float
+    policy: str = LearningRatePolicy.NONE
+    decay_rate: float = 0.0
+    steps: float = 1.0
+    power: float = 1.0
+    max_iter: float = 10000.0
+    schedule: Optional[Dict[int, float]] = None  # iteration -> lr (SCHEDULE policy)
+
+    def __call__(self, step):
+        p = str(self.policy).lower()
+        it = jnp.asarray(step, dtype=jnp.float32)
+        if p == LearningRatePolicy.NONE:
+            return jnp.asarray(self.base_lr, dtype=jnp.float32)
+        if p == LearningRatePolicy.EXPONENTIAL:
+            return self.base_lr * jnp.power(self.decay_rate, it)
+        if p == LearningRatePolicy.INVERSE:
+            return self.base_lr / jnp.power(1.0 + self.decay_rate * it, self.power)
+        if p == LearningRatePolicy.POLY:
+            frac = jnp.clip(it / self.max_iter, 0.0, 1.0)
+            return self.base_lr * jnp.power(1.0 - frac, self.power)
+        if p == LearningRatePolicy.SIGMOID:
+            return self.base_lr / (1.0 + jnp.exp(-self.decay_rate * (it - self.steps)))
+        if p == LearningRatePolicy.STEP:
+            return self.base_lr * jnp.power(self.decay_rate, jnp.floor(it / self.steps))
+        if p == LearningRatePolicy.TORCH_STEP:
+            return self.base_lr * jnp.power(self.decay_rate, jnp.floor(it / self.steps))
+        if p == LearningRatePolicy.SCHEDULE:
+            # Piecewise-constant: lr changes at given iterations. Traced as a
+            # chain of wheres (static key set) — jit-safe.
+            lr = jnp.asarray(self.base_lr, dtype=jnp.float32)
+            if self.schedule:
+                for k in sorted(self.schedule, key=int):
+                    lr = jnp.where(it >= int(k), jnp.float32(self.schedule[k]), lr)
+            return lr
+        if p == LearningRatePolicy.SCORE:
+            # Host-driven; inside jit we just use base lr (Solver rescales).
+            return jnp.asarray(self.base_lr, dtype=jnp.float32)
+        raise ValueError(f"Unknown learning rate policy '{self.policy}'")
+
+    def to_dict(self):
+        return {
+            "base_lr": self.base_lr, "policy": self.policy,
+            "decay_rate": self.decay_rate, "steps": self.steps,
+            "power": self.power, "max_iter": self.max_iter,
+            "schedule": {str(k): v for k, v in (self.schedule or {}).items()} or None,
+        }
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        if d.get("schedule"):
+            d["schedule"] = {int(k): float(v) for k, v in d["schedule"].items()}
+        return Schedule(**d)
+
+
+def make_schedule(base_lr, policy=LearningRatePolicy.NONE, **kw) -> Schedule:
+    return Schedule(base_lr=base_lr, policy=policy, **kw)

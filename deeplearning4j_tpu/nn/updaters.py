@@ -1,0 +1,224 @@
+"""Gradient updaters (optimizers).
+
+Parity with the reference's `nn/conf/Updater.java:10` enum (SGD, ADAM, ADADELTA,
+NESTEROVS, ADAGRAD, RMSPROP, NONE) plus ADAMAX — the math the reference
+delegates to ND4J `GradientUpdater` implementations (see
+`nn/updater/LayerUpdater.java:30`). Here each updater is a pure pytree
+transform:
+
+    state   = updater.init(params)
+    updates, state = updater.update(grads, state, step, lr)
+    params  = tree_map(lambda p, u: p - u, params, updates)
+
+so the whole optimizer step fuses into the jitted train step (no per-variable
+host loop like `MultiLayerUpdater.update`, `nn/updater/MultiLayerUpdater.java:115`).
+Learning-rate schedules (`schedules.Schedule`) are applied by passing the
+scheduled lr in; per-layer learning rates are handled by the network applying a
+different lr per layer subtree (reference: per-layer `learningRateByParam`).
+
+Updater state is itself a pytree, so checkpointing (`updaterState.bin`
+equivalent) and cross-replica averaging (`ParallelWrapper.averageUpdatersState`,
+`ParallelWrapper.java:239`) fall out for free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Updater", "Sgd", "Adam", "AdaMax", "AdaGrad", "AdaDelta", "RmsProp",
+    "Nesterovs", "NoOp", "get", "from_dict", "UPDATERS",
+]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _zeros_like(params):
+    return _tmap(jnp.zeros_like, params)
+
+
+@dataclass
+class Updater:
+    """Base. Subclasses define init/update. `learning_rate` is the default lr
+    used when the caller does not pass a scheduled/overridden lr."""
+
+    learning_rate: float = 0.1
+
+    def init(self, params) -> Any:
+        return ()
+
+    def update(self, grads, state, step, lr=None):
+        raise NotImplementedError
+
+    # --- serde -----------------------------------------------------------
+    def to_dict(self) -> Dict:
+        d = {k: getattr(self, k) for k in self.__dataclass_fields__}
+        d["type"] = type(self).__name__
+        return d
+
+    def __repr__(self):
+        fields = ", ".join(f"{k}={getattr(self, k)}" for k in self.__dataclass_fields__)
+        return f"{type(self).__name__}({fields})"
+
+
+@dataclass
+class Sgd(Updater):
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        return _tmap(lambda g: lr * g, grads), state
+
+
+@dataclass
+class NoOp(Updater):
+    """Updater.NONE — gradients applied raw (lr ignored)."""
+
+    def update(self, grads, state, step, lr=None):
+        return grads, state
+
+
+@dataclass
+class Adam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        # bias-corrected step size (same form ND4J AdamUpdater uses)
+        alpha = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+        upd = _tmap(lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + eps), m, v)
+        return upd, {"m": m, "v": v}
+
+
+@dataclass
+class AdaMax(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _zeros_like(params), "u": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = _tmap(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g)), state["u"], grads)
+        alpha = lr / (1.0 - b1 ** t)
+        upd = _tmap(lambda m_, u_: alpha * m_ / (u_ + self.epsilon), m, u)
+        return upd, {"m": m, "u": u}
+
+
+@dataclass
+class AdaGrad(Updater):
+    learning_rate: float = 0.1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        h = _tmap(lambda h_, g: h_ + g * g, state["h"], grads)
+        upd = _tmap(lambda g, h_: lr * g / (jnp.sqrt(h_) + self.epsilon), grads, h)
+        return upd, {"h": h}
+
+
+@dataclass
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"msg": _zeros_like(params), "msdx": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        rho, eps = self.rho, self.epsilon
+        msg = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, state["msg"], grads)
+        upd = _tmap(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, msg, state["msdx"],
+        )
+        msdx = _tmap(lambda d, u: rho * d + (1 - rho) * u * u, state["msdx"], upd)
+        return upd, {"msg": msg, "msdx": msdx}
+
+
+@dataclass
+class RmsProp(Updater):
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"g2": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        d = self.rms_decay
+        g2 = _tmap(lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
+        upd = _tmap(lambda g, a: lr * g / (jnp.sqrt(a) + self.epsilon), grads, g2)
+        return upd, {"g2": g2}
+
+
+@dataclass
+class Nesterovs(Updater):
+    """Nesterov accelerated momentum (ND4J NesterovsUpdater form):
+    v_new = mu*v - lr*g;  params += mu*v_new - lr*g  (equivalently
+    update = lr*g - mu*v_new under the params -= update convention)."""
+
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": _zeros_like(params)}
+
+    def update(self, grads, state, step, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        mu = self.momentum
+        v_new = _tmap(lambda v, g: mu * v - lr * g, state["v"], grads)
+        upd = _tmap(lambda vn, g: lr * g - mu * vn, v_new, grads)
+        return upd, {"v": v_new}
+
+
+UPDATERS = {
+    "sgd": Sgd, "adam": Adam, "adamax": AdaMax, "adagrad": AdaGrad,
+    "adadelta": AdaDelta, "rmsprop": RmsProp, "nesterovs": Nesterovs,
+    "none": NoOp, "noop": NoOp,
+}
+
+
+def get(name, learning_rate=None, **kw) -> Updater:
+    """Resolve an updater by enum-style name or pass through an instance."""
+    if isinstance(name, Updater):
+        return name
+    cls = UPDATERS.get(str(name).lower())
+    if cls is None:
+        raise ValueError(f"Unknown updater '{name}'. Available: {sorted(UPDATERS)}")
+    if learning_rate is not None and "learning_rate" in cls.__dataclass_fields__:
+        kw["learning_rate"] = learning_rate
+    return cls(**kw)
+
+
+def from_dict(d: Dict) -> Updater:
+    d = dict(d)
+    t = d.pop("type")
+    for cls in (Sgd, Adam, AdaMax, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp):
+        if cls.__name__ == t:
+            allowed = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+            return cls(**allowed)
+    raise ValueError(f"Unknown updater type '{t}'")

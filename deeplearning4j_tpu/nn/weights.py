@@ -1,0 +1,153 @@
+"""Weight initialization schemes.
+
+Parity with the reference's `nn/weights/WeightInit.java:47` enum
+(DISTRIBUTION, ZERO, SIGMOID_UNIFORM, UNIFORM, XAVIER, XAVIER_UNIFORM,
+XAVIER_FAN_IN, XAVIER_LEGACY, RELU, RELU_UNIFORM) and
+`nn/weights/WeightInitUtil.java`'s formulas, realized as pure
+`jax.random`-keyed initializers (TPU-native: deterministic, splittable PRNG
+instead of a global RNG).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["WeightInit", "init_weight", "Distribution"]
+
+
+class WeightInit:
+    DISTRIBUTION = "distribution"
+    ZERO = "zero"
+    ONES = "ones"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    NORMAL = "normal"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    IDENTITY = "identity"
+
+    ALL = [
+        DISTRIBUTION, ZERO, ONES, SIGMOID_UNIFORM, UNIFORM, XAVIER,
+        XAVIER_UNIFORM, XAVIER_FAN_IN, XAVIER_LEGACY, RELU, RELU_UNIFORM,
+        NORMAL, LECUN_NORMAL, LECUN_UNIFORM, VAR_SCALING_NORMAL_FAN_AVG,
+        IDENTITY,
+    ]
+
+
+@dataclass
+class Distribution:
+    """Custom distribution for WeightInit.DISTRIBUTION (reference:
+    `nn/conf/distribution/{Normal,Uniform,Binomial,GaussianDistribution}.java`)."""
+
+    kind: str = "normal"  # normal | uniform | binomial | constant
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n_trials: int = 1
+    prob: float = 0.5
+    value: float = 0.0
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        k = self.kind.lower()
+        if k in ("normal", "gaussian"):
+            return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+        if k == "uniform":
+            return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+        if k == "binomial":
+            return jax.random.binomial(
+                rng, self.n_trials, self.prob, shape
+            ).astype(dtype)
+        if k == "constant":
+            return jnp.full(shape, self.value, dtype)
+        raise ValueError(f"Unknown distribution kind '{self.kind}'")
+
+    def to_dict(self):
+        return {"kind": self.kind, "mean": self.mean, "std": self.std,
+                "lower": self.lower, "upper": self.upper,
+                "n_trials": self.n_trials, "prob": self.prob, "value": self.value}
+
+    @staticmethod
+    def from_dict(d):
+        return Distribution(**d)
+
+
+def init_weight(
+    rng: jax.Array,
+    shape: Sequence[int],
+    scheme: str = WeightInit.XAVIER,
+    fan_in: Optional[float] = None,
+    fan_out: Optional[float] = None,
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Initialize a weight tensor.
+
+    fan_in/fan_out default to shape[0]/shape[-1] for 2-D weights; conv layers
+    pass receptive-field-scaled fans explicitly (as the reference does via
+    `ConvolutionParamInitializer`).
+    """
+    shape = tuple(int(s) for s in shape)
+    if fan_in is None:
+        fan_in = float(shape[0]) if len(shape) > 1 else float(shape[0])
+    if fan_out is None:
+        fan_out = float(shape[-1]) if len(shape) > 1 else float(shape[0])
+    s = str(scheme).lower()
+
+    if s == WeightInit.DISTRIBUTION:
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
+        return distribution.sample(rng, shape, dtype)
+    if s == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if s == WeightInit.SIGMOID_UNIFORM:
+        r = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -r, r)
+    if s == WeightInit.UNIFORM:
+        # Reference WeightInitUtil: U(-a, a), a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s == WeightInit.XAVIER:
+        # Gaussian, var = 2/(fanIn+fanOut)
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if s == WeightInit.XAVIER_UNIFORM:
+        r = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -r, r)
+    if s == WeightInit.XAVIER_FAN_IN:
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(fan_in)
+    if s == WeightInit.XAVIER_LEGACY:
+        # Legacy DL4J: N(0, 1/(fanIn+fanOut))
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / (fan_in + fan_out))
+    if s == WeightInit.RELU:
+        # He: N(0, 2/fanIn)
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if s == WeightInit.RELU_UNIFORM:
+        r = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -r, r)
+    if s == WeightInit.NORMAL:
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(fan_in)
+    if s == WeightInit.LECUN_NORMAL:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if s == WeightInit.LECUN_UNIFORM:
+        r = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -r, r)
+    if s == WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if s == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"Unknown weight init '{scheme}'. Available: {WeightInit.ALL}")

@@ -1,0 +1,11 @@
+from .listeners import (
+    IterationListener, TrainingListener, ScoreIterationListener,
+    PerformanceListener, CollectScoresIterationListener,
+    ComposableIterationListener, ParamAndGradientIterationListener,
+)
+
+__all__ = [
+    "IterationListener", "TrainingListener", "ScoreIterationListener",
+    "PerformanceListener", "CollectScoresIterationListener",
+    "ComposableIterationListener", "ParamAndGradientIterationListener",
+]

@@ -1,0 +1,157 @@
+"""Training listeners.
+
+Parity with `optimize/api/IterationListener.java` / `TrainingListener.java` and
+the impls in `optimize/listeners/`: ScoreIterationListener, PerformanceListener
+(samples/sec), CollectScoresIterationListener, ParamAndGradientIterationListener,
+ComposableIterationListener.
+
+Listeners run host-side between jitted steps; they see the model, the iteration
+number and the (host-synced) score. Heavy introspection (param/gradient stats)
+pulls device arrays — the PerformanceListener notes when that forces a sync.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = [
+    "IterationListener", "TrainingListener", "ScoreIterationListener",
+    "PerformanceListener", "CollectScoresIterationListener",
+    "ComposableIterationListener", "ParamAndGradientIterationListener",
+]
+
+
+class IterationListener:
+    """Per-iteration hook (reference `optimize/api/IterationListener.java`)."""
+
+    invoked = False
+
+    def iteration_done(self, model, iteration: int):
+        pass
+
+
+class TrainingListener(IterationListener):
+    """Adds epoch/forward/backward hooks (reference `optimize/api/TrainingListener.java`)."""
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Logs score every N iterations (`optimize/listeners/ScoreIterationListener.java`)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Optional[Callable] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(IterationListener):
+    """Samples/sec + batches/sec reporting (`optimize/listeners/PerformanceListener.java`).
+    This is the metric surfaced by bench.py."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False,
+                 printer: Optional[Callable] = None):
+        self.frequency = max(1, int(frequency))
+        self.report_score = report_score
+        self.printer = printer or (lambda s: log.info(s))
+        self._last_time = None
+        self._samples = 0
+        self._batches = 0
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration: int):
+        now = time.perf_counter()
+        batch = getattr(model, "last_batch_size", 0)
+        self._samples += batch
+        self._batches += 1
+        if self._last_time is None:
+            self._last_time = now
+            self._samples = 0
+            self._batches = 0
+            return
+        if self._batches >= self.frequency:
+            dt = now - self._last_time
+            rec = {
+                "iteration": iteration,
+                "samples_per_sec": self._samples / dt if dt > 0 else float("nan"),
+                "batches_per_sec": self._batches / dt if dt > 0 else float("nan"),
+            }
+            if self.report_score:
+                rec["score"] = float(model.score())
+            self.history.append(rec)
+            self.printer(
+                f"iteration {iteration}: {rec['samples_per_sec']:.1f} samples/sec, "
+                f"{rec['batches_per_sec']:.2f} batches/sec")
+            self._last_time = now
+            self._samples = 0
+            self._batches = 0
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collects (iteration, score) pairs (`optimize/listeners/CollectScoresIterationListener.java`)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(model.score())))
+
+    def export_scores(self, path, delimiter=","):
+        with open(path, "w") as f:
+            f.write(f"iteration{delimiter}score\n")
+            for it, s in self.scores:
+                f.write(f"{it}{delimiter}{s}\n")
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter/gradient statistics
+    (`optimize/listeners/ParamAndGradientIterationListener.java`). Pulls device
+    arrays to host — use sparingly."""
+
+    def __init__(self, frequency: int = 1, printer: Optional[Callable] = None):
+        self.frequency = max(1, int(frequency))
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency != 0:
+            return
+        leaves = jax.tree_util.tree_leaves(model.params)
+        if not leaves:
+            return
+        flat = np.concatenate([np.asarray(l).ravel() for l in leaves])
+        self.printer(
+            f"iter {iteration}: |params| mean abs {np.abs(flat).mean():.3e}, "
+            f"l2 {np.linalg.norm(flat):.3e}")
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration: int):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)

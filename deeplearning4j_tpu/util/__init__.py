@@ -1,0 +1,4 @@
+from .serializer import ModelSerializer
+from .gradient_check import GradientCheckUtil
+
+__all__ = ["ModelSerializer", "GradientCheckUtil"]

@@ -1,0 +1,73 @@
+"""Config DSL tests: builder fluency, inheritance resolution, shape inference,
+JSON round-trip (the reference's canonical serialization contract —
+`MultiLayerConfTest` style)."""
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                MultiLayerConfiguration,
+                                NeuralNetConfiguration, OutputLayer, Sgd,
+                                WeightInit)
+from deeplearning4j_tpu.nn.conf import GradientNormalization
+
+
+def _build():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(Adam(1e-3))
+            .weight_init(WeightInit.RELU)
+            .l2(1e-4)
+            .gradient_normalization(GradientNormalization.CLIP_L2_PER_LAYER, 5.0)
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="tanh",
+                              weight_init=WeightInit.XAVIER, l2=0.0))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+
+
+def test_shape_inference_fills_n_in():
+    conf = _build()
+    assert conf.layers[0].n_in == 10
+    assert conf.layers[1].n_in == 32
+    assert conf.layers[2].n_in == 16
+
+
+def test_global_inheritance_and_per_layer_override():
+    conf = _build()
+    # layer 0 inherits global weight init + l2
+    assert conf.layers[0].weight_init == WeightInit.RELU
+    assert conf.layers[0].l2 == 1e-4
+    # layer 1 overrides both
+    assert conf.layers[1].weight_init == WeightInit.XAVIER
+    assert conf.layers[1].l2 == 0.0
+    # updater inherited everywhere
+    assert type(conf.layers[0].updater).__name__ == "Adam"
+    assert conf.layers[0].gradient_normalization == GradientNormalization.CLIP_L2_PER_LAYER
+
+
+def test_json_roundtrip():
+    conf = _build()
+    js = conf.to_json()
+    back = MultiLayerConfiguration.from_json(js)
+    assert back.to_json() == js
+    assert len(back.layers) == 3
+    assert back.layers[0].n_in == 10
+    assert back.layers[2].loss == "mcxent"
+    assert back.conf.seed == 42
+    assert type(back.conf.updater).__name__ == "Adam"
+    assert back.input_type == InputType.feed_forward(10)
+
+
+def test_layer_index_insertion():
+    b = (NeuralNetConfiguration.builder().list())
+    b.layer(1, OutputLayer(n_in=4, n_out=2))
+    b.layer(0, DenseLayer(n_in=8, n_out=4))
+    conf = b.build()
+    assert isinstance(conf.layers[0], DenseLayer)
+    assert isinstance(conf.layers[1], OutputLayer)
+
+
+def test_yaml_aliases_json():
+    conf = _build()
+    assert MultiLayerConfiguration.from_yaml(conf.to_yaml()).to_json() == conf.to_json()

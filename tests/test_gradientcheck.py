@@ -1,0 +1,82 @@
+"""Gradient checks — the correctness backbone (reference:
+`gradientcheck/GradientCheckTests.java`, 11 @Test over activation/loss
+combinations; harness `GradientCheckUtil.java:75`)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, DenseLayer, GradientCheckUtil,
+                                InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer, Sgd)
+
+from conftest import make_classification
+
+
+def _net(activation, loss, out_act, l1=0.0, l2=0.0, n_out=3):
+    b = (NeuralNetConfiguration.builder()
+         .seed(12345)
+         .updater(Sgd(0.1)))
+    if l1 or l2:
+        b = b.l1(l1).l2(l2)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=8, activation=activation))
+            .layer(OutputLayer(n_out=n_out, activation=out_act, loss=loss))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n_out=3, regression=False, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(12, 5))
+    if regression:
+        y = r.normal(size=(12, n_out))
+    else:
+        idx = r.integers(0, n_out, 12)
+        y = np.zeros((12, n_out))
+        y[np.arange(12), idx] = 1.0
+    return DataSet(x, y)
+
+
+# The reference's GradientCheckTests matrix: activations x losses
+@pytest.mark.parametrize("activation,loss,out_act,regression", [
+    ("relu", "mcxent", "softmax", False),
+    ("tanh", "mcxent", "softmax", False),
+    ("sigmoid", "xent", "sigmoid", False),
+    ("elu", "mse", "identity", True),
+    ("softplus", "mse", "tanh", True),
+    ("leakyrelu", "negativeloglikelihood", "softmax", False),
+    ("selu", "mae", "identity", True),
+    ("gelu", "mcxent", "softmax", False),
+    ("cube", "mse", "identity", True),
+    ("rationaltanh", "mse", "identity", True),
+    ("softsign", "l2", "identity", True),
+    ("hardtanh", "mse", "identity", True),
+])
+def test_gradients_activation_loss_matrix(activation, loss, out_act, regression):
+    net = _net(activation, loss, out_act)
+    ds = _data(regression=regression)
+    assert GradientCheckUtil.check_gradients(net, ds, print_results=False), \
+        f"gradient check failed for {activation}/{loss}"
+
+
+def test_gradients_with_regularization():
+    net = _net("tanh", "mcxent", "softmax", l1=0.01, l2=0.02)
+    assert GradientCheckUtil.check_gradients(net, _data())
+
+
+@pytest.mark.parametrize("loss,out_act,regression", [
+    ("hinge", "identity", False),
+    ("squared_hinge", "identity", False),
+    ("poisson", "softplus", True),
+    ("kl_divergence", "softmax", False),
+    ("cosine_proximity", "identity", True),
+    ("mape", "identity", True),
+    ("msle", "softplus", True),
+])
+def test_loss_function_gradients(loss, out_act, regression):
+    """Reference: LossFunctionGradientCheck.java."""
+    net = _net("tanh", loss, out_act)
+    ds = _data(regression=regression, seed=3)
+    if loss in ("poisson", "msle"):
+        ds = DataSet(ds.features, np.abs(ds.labels) + 0.1)
+    assert GradientCheckUtil.check_gradients(net, ds), f"{loss} failed"

@@ -1,0 +1,140 @@
+"""Unit tests for losses, activations, weight init, schedules, iterators."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, losses, weights
+from deeplearning4j_tpu.nn.schedules import LearningRatePolicy, Schedule
+from deeplearning4j_tpu.datasets import (ArrayDataSetIterator,
+                                         AsyncDataSetIterator, DataSet,
+                                         MultipleEpochsIterator)
+
+
+def test_mse_value():
+    y = jnp.array([[1.0, 2.0]])
+    out = jnp.array([[0.0, 0.0]])
+    v = losses.get("mse").score(y, out, activation="identity")
+    np.testing.assert_allclose(float(v), (1 + 4) / 2, rtol=1e-6)
+
+
+def test_mcxent_softmax_fused_matches_naive():
+    logits = jnp.array([[2.0, -1.0, 0.5], [0.1, 0.2, 0.3]])
+    labels = jnp.array([[1.0, 0.0, 0.0], [0.0, 0.0, 1.0]])
+    fused = losses.get("mcxent").score(labels, logits, activation="softmax")
+    probs = jax.nn.softmax(logits, axis=-1)
+    naive = -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+    np.testing.assert_allclose(float(fused), float(naive), rtol=1e-6)
+
+
+def test_xent_sigmoid_fused_matches_naive():
+    logits = jnp.array([[2.0, -3.0]])
+    labels = jnp.array([[1.0, 0.0]])
+    fused = losses.get("xent").score(labels, logits, activation="sigmoid")
+    p = jax.nn.sigmoid(logits)
+    naive = -jnp.mean(jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p), axis=-1))
+    np.testing.assert_allclose(float(fused), float(naive), rtol=1e-5)
+
+
+def test_masked_loss():
+    y = jnp.ones((2, 3))
+    out = jnp.zeros((2, 3))
+    mask = jnp.array([1.0, 0.0])
+    v = losses.get("mse").score(y, out, activation="identity", mask=mask)
+    np.testing.assert_allclose(float(v), 1.0, rtol=1e-6)  # only first row counts
+
+
+def test_softmax_rows_sum_to_one():
+    x = jnp.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]])
+    s = activations.get("softmax")(x)
+    np.testing.assert_allclose(np.asarray(s).sum(axis=1), 1.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", sorted(activations.ACTIVATIONS))
+def test_activation_finite(name):
+    x = jnp.linspace(-3, 3, 7).reshape(1, 7)
+    y = activations.get(name)(x)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+@pytest.mark.parametrize("scheme", weights.WeightInit.ALL)
+def test_weight_init_schemes(scheme):
+    rng = jax.random.PRNGKey(0)
+    kw = {}
+    if scheme == weights.WeightInit.DISTRIBUTION:
+        kw["distribution"] = weights.Distribution(kind="uniform", lower=-2, upper=2)
+    shape = (64, 64)
+    w = weights.init_weight(rng, shape, scheme, **kw)
+    assert w.shape == shape
+    assert np.isfinite(np.asarray(w)).all()
+
+
+def test_xavier_std():
+    rng = jax.random.PRNGKey(1)
+    w = np.asarray(weights.init_weight(rng, (500, 300), weights.WeightInit.XAVIER))
+    expected = np.sqrt(2.0 / 800)
+    assert abs(w.std() - expected) / expected < 0.05
+
+
+def test_relu_init_std():
+    rng = jax.random.PRNGKey(2)
+    w = np.asarray(weights.init_weight(rng, (500, 300), weights.WeightInit.RELU))
+    expected = np.sqrt(2.0 / 500)
+    assert abs(w.std() - expected) / expected < 0.05
+
+
+def test_schedules():
+    s = Schedule(0.1, LearningRatePolicy.STEP, decay_rate=0.5, steps=10)
+    np.testing.assert_allclose(float(s(0)), 0.1)
+    np.testing.assert_allclose(float(s(10)), 0.05)
+    np.testing.assert_allclose(float(s(25)), 0.025)
+    e = Schedule(0.1, LearningRatePolicy.EXPONENTIAL, decay_rate=0.9)
+    np.testing.assert_allclose(float(e(2)), 0.1 * 0.81, rtol=1e-6)
+    m = Schedule(1.0, LearningRatePolicy.SCHEDULE, schedule={5: 0.5, 20: 0.1})
+    assert float(m(0)) == 1.0 and float(m(7)) == 0.5 and float(m(30)) == pytest.approx(0.1)
+    d = Schedule.from_dict(m.to_dict())
+    assert float(d(30)) == pytest.approx(0.1)
+
+
+def test_array_iterator_batches():
+    x = np.arange(20).reshape(10, 2).astype(np.float32)
+    y = np.arange(10).reshape(10, 1).astype(np.float32)
+    it = ArrayDataSetIterator(x, y, batch_size=4)
+    sizes = [d.num_examples() for d in it]
+    assert sizes == [4, 4, 2]
+    it2 = ArrayDataSetIterator(x, y, batch_size=4, drop_last=True)
+    assert [d.num_examples() for d in it2] == [4, 4]
+
+
+def test_async_iterator_matches_sync():
+    x = np.random.default_rng(0).normal(size=(33, 3))
+    y = np.ones((33, 1))
+    sync = ArrayDataSetIterator(x, y, batch_size=8)
+    asy = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8))
+    a = [d.features for d in sync]
+    b = [d.features for d in asy]
+    assert len(a) == len(b)
+    for u, v in zip(a, b):
+        np.testing.assert_array_equal(u, v)
+    # reset works
+    asy.reset()
+    assert sum(d.num_examples() for d in asy) == 33
+
+
+def test_multiple_epochs_iterator():
+    x = np.zeros((6, 1)); y = np.zeros((6, 1))
+    it = MultipleEpochsIterator(3, ArrayDataSetIterator(x, y, batch_size=3))
+    count = 0
+    it.reset()
+    while it.has_next():
+        it.next(); count += 1
+    assert count == 6  # 2 batches x 3 epochs
+
+
+def test_dataset_merge_split():
+    a = DataSet(np.ones((2, 3)), np.zeros((2, 1)))
+    b = DataSet(np.zeros((3, 3)), np.ones((3, 1)))
+    m = DataSet.merge([a, b])
+    assert m.num_examples() == 5
+    tr, te = m.split_test_and_train(2)
+    assert tr.num_examples() == 2 and te.num_examples() == 3

@@ -1,0 +1,144 @@
+"""End-to-end MLP slice: train on synthetic classification, evaluate,
+checkpoint round-trip + resume (reference pattern: the MultiLayerTest /
+ModelSerializerTest suites in deeplearning4j-core)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, DataSet,
+                                DenseLayer, Evaluation, InputType,
+                                ModelSerializer, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.optimize import (CollectScoresIterationListener,
+                                         PerformanceListener,
+                                         ScoreIterationListener)
+
+from conftest import make_classification
+
+
+def _model(seed=42, updater=None):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_mlp_learns(classification_data):
+    xs, ys = classification_data
+    model = _model()
+    it = ArrayDataSetIterator(xs, ys, batch_size=32, shuffle=True, seed=1)
+    scores = CollectScoresIterationListener()
+    model.set_listeners(scores)
+    model.fit(it, epochs=30)
+    ev = model.evaluate(ArrayDataSetIterator(xs, ys, batch_size=64))
+    assert ev.accuracy() > 0.93, ev.stats()
+    # score decreased
+    assert scores.scores[-1][1] < scores.scores[0][1]
+
+
+def test_listeners_fire(classification_data):
+    xs, ys = classification_data
+    model = _model()
+    perf = PerformanceListener(frequency=2)
+    printed = []
+    sil = ScoreIterationListener(1, printer=printed.append)
+    model.set_listeners(perf, sil)
+    model.fit(ArrayDataSetIterator(xs, ys, batch_size=64), epochs=2)
+    assert printed
+    assert perf.history
+    assert perf.history[-1]["samples_per_sec"] > 0
+
+
+def test_predict_shapes(classification_data):
+    xs, ys = classification_data
+    model = _model()
+    out = model.output(xs[:7])
+    assert out.shape == (7, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-5)
+    preds = model.predict(xs[:7])
+    assert preds.shape == (7,)
+
+
+def test_fit_single_dataset_and_score(classification_data):
+    xs, ys = classification_data
+    model = _model()
+    ds = DataSet(xs[:32], ys[:32])
+    s0 = model.score(ds)
+    for _ in range(20):
+        model.fit(ds)
+    assert model.score(ds) < s0
+    assert model.iteration_count == 20
+
+
+def test_checkpoint_roundtrip(tmp_path, classification_data):
+    xs, ys = classification_data
+    model = _model()
+    model.fit(ArrayDataSetIterator(xs, ys, batch_size=64), epochs=3)
+    out_before = np.asarray(model.output(xs[:16]))
+
+    path = os.path.join(tmp_path, "model.zip")
+    ModelSerializer.write_model(model, path)
+    restored = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(restored.output(xs[:16])),
+                               out_before, rtol=1e-6)
+    assert restored.iteration_count == model.iteration_count
+
+    # resume training: identical continuation as the original (updater state
+    # restored — the reference's updaterState.bin contract)
+    ds = DataSet(xs[:64], ys[:64])
+    model.fit(ds)
+    restored.fit(ds)
+    np.testing.assert_allclose(restored.params_flat(), model.params_flat(),
+                               rtol=1e-5)
+
+
+def test_restore_format_sniffing(tmp_path, classification_data):
+    xs, ys = classification_data
+    model = _model()
+    path = os.path.join(tmp_path, "m.zip")
+    ModelSerializer.write_model(model, path)
+    m2 = ModelSerializer.restore(path)
+    assert isinstance(m2, MultiLayerNetwork)
+
+
+def test_params_flat_roundtrip(classification_data):
+    model = _model()
+    vec = model.params_flat()
+    assert vec.ndim == 1 and vec.size == model.num_params()
+    model2 = _model(seed=7)
+    model2.set_params_flat(vec)
+    np.testing.assert_allclose(model2.params_flat(), vec)
+
+
+def test_determinism_same_seed(classification_data):
+    xs, ys = classification_data
+    m1, m2 = _model(seed=9), _model(seed=9)
+    ds = DataSet(xs[:64], ys[:64])
+    for _ in range(3):
+        m1.fit(ds)
+        m2.fit(ds)
+    np.testing.assert_allclose(m1.params_flat(), m2.params_flat(), rtol=1e-6)
+
+
+def test_frozen_layer_not_updated(classification_data):
+    xs, ys = classification_data
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu", frozen=True))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    w_before = np.asarray(model.params[0]["W"]).copy()
+    out_before = np.asarray(model.params[1]["W"]).copy()
+    model.fit(DataSet(xs[:64], ys[:64]))
+    np.testing.assert_array_equal(np.asarray(model.params[0]["W"]), w_before)
+    # but output layer did move
+    assert not np.allclose(np.asarray(model.params[1]["W"]), out_before)

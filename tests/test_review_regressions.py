@@ -1,0 +1,76 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+
+from deeplearning4j_tpu import (DataSet, DenseLayer, Evaluation, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd)
+from deeplearning4j_tpu.datasets import IteratorDataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.nn.schedules import LearningRatePolicy
+
+
+def test_bias_lr_with_schedule_traces():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Sgd(0.1))
+            .learning_rate_decay_policy(LearningRatePolicy.EXPONENTIAL,
+                                        decay_rate=0.99)
+            .list()
+            .layer(DenseLayer(n_out=4, activation="tanh",
+                              bias_learning_rate=0.05))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    x = np.zeros((4, 3), np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    m.fit(DataSet(x, y))  # crashed with TracerBoolConversionError before fix
+    assert np.isfinite(m.score())
+
+
+def test_binary_single_column_evaluation():
+    ev = Evaluation()
+    labels = np.array([[1.0], [0.0], [1.0], [0.0]])
+    preds = np.array([[0.9], [0.1], [0.8], [0.4]])
+    ev.eval(labels, preds)
+    assert ev.accuracy() == 1.0
+    assert ev.num_classes == 2
+
+
+def test_merge_aligns_missing_masks():
+    a = DataSet(np.ones((3, 2, 4)), np.ones((3, 2, 2)),
+                features_mask=np.ones((3, 2)))
+    b = DataSet(np.zeros((2, 2, 4)), np.zeros((2, 2, 2)))  # no mask
+    m = DataSet.merge([a, b])
+    assert m.features_mask.shape == (5, 2)
+    assert m.features_mask[3:].all()  # filled with ones
+
+
+def test_iterator_rebatch_keeps_masks():
+    dss = [DataSet(np.ones((3, 2, 4)), np.ones((3, 2, 2)),
+                   features_mask=np.ones((3, 2)),
+                   labels_mask=np.ones((3, 2))) for _ in range(3)]
+    it = IteratorDataSetIterator(ListDataSetIterator(dss), batch_size=4)
+    it.reset()
+    batches = []
+    while it.has_next():
+        batches.append(it.next())
+    assert sum(d.num_examples() for d in batches) == 9
+    for d in batches:
+        assert d.features_mask is not None
+        assert d.features_mask.shape[0] == d.num_examples()
+        assert d.labels_mask is not None
+
+
+def test_clone_independent_buffers():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    c = m.clone()
+    x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+    m.fit(DataSet(x, y))  # donates m's old buffers
+    out = c.output(x)  # must not touch deleted buffers
+    assert np.isfinite(np.asarray(out)).all()
