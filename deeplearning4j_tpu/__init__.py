@@ -16,8 +16,13 @@ from .nn import (BackpropType, GradientNormalization, InputType,
                  MultiLayerConfiguration, MultiLayerNetwork,
                  NeuralNetConfiguration, NeuralNetConfigurationBuilder,
                  OptimizationAlgorithm)
-from .nn.layers import (ActivationLayer, DenseLayer, DropoutLayer,
-                        EmbeddingLayer, LossLayer, OutputLayer)
+from .nn.layers import (ActivationLayer, BatchNormalization,
+                        Convolution1DLayer, ConvolutionLayer, ConvolutionMode,
+                        DenseLayer, DropoutLayer, EmbeddingLayer,
+                        GlobalPoolingLayer, LocalResponseNormalization,
+                        LossLayer, OutputLayer, PoolingType,
+                        Subsampling1DLayer, SubsamplingLayer,
+                        ZeroPaddingLayer)
 from .nn.updaters import (AdaDelta, AdaGrad, Adam, AdaMax, Nesterovs, NoOp,
                           RmsProp, Sgd)
 from .nn.weights import Distribution, WeightInit
@@ -29,8 +34,11 @@ __all__ = [
     "BackpropType", "GradientNormalization", "InputType",
     "MultiLayerConfiguration", "MultiLayerNetwork", "NeuralNetConfiguration",
     "NeuralNetConfigurationBuilder", "OptimizationAlgorithm",
-    "ActivationLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
-    "LossLayer", "OutputLayer",
+    "ActivationLayer", "BatchNormalization", "Convolution1DLayer",
+    "ConvolutionLayer", "ConvolutionMode", "DenseLayer", "DropoutLayer",
+    "EmbeddingLayer", "GlobalPoolingLayer", "LocalResponseNormalization",
+    "LossLayer", "OutputLayer", "PoolingType", "Subsampling1DLayer",
+    "SubsamplingLayer", "ZeroPaddingLayer",
     "AdaDelta", "AdaGrad", "Adam", "AdaMax", "Nesterovs", "NoOp", "RmsProp",
     "Sgd", "Distribution", "WeightInit",
     "ArrayDataSetIterator", "DataSet", "DataSetIterator", "Evaluation",

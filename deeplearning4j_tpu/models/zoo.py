@@ -1,0 +1,75 @@
+"""Model zoo — the BASELINE.md configs.
+
+LeNet-MNIST mirrors the reference's canonical MNIST CNN example topology
+(Conv 5x5x20 → maxpool → Conv 5x5x50 → maxpool → Dense 500 → softmax 10),
+the config DL4J ships in its examples and the first BASELINE config.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..nn.conf import InputType, NeuralNetConfiguration
+from ..nn.layers import (ConvolutionLayer, ConvolutionMode, DenseLayer,
+                         OutputLayer, PoolingType, SubsamplingLayer)
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.updaters import Adam, Nesterovs
+
+__all__ = ["lenet_mnist", "bench_lenet", "mlp_mnist"]
+
+
+def lenet_mnist(seed: int = 42, updater=None) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Nesterovs(learning_rate=0.01, momentum=0.9))
+            .l2(5e-4)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                    stride=(1, 1), activation="identity",
+                                    convolution_mode=ConvolutionMode.TRUNCATE))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                    stride=(1, 1), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def mlp_mnist(seed: int = 42) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=1024, activation="relu"))
+            .layer(DenseLayer(n_out=1024, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def bench_lenet(batch: int = 512, steps: int = 40, warmup: int = 5):
+    """samples/sec for LeNet-MNIST training steps (BASELINE config #1)."""
+    import jax
+
+    from ..datasets.iterators import DataSet
+
+    model = lenet_mnist().init()
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "LeNet-MNIST"

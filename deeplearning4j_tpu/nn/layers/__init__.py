@@ -3,8 +3,18 @@ from .feedforward import (
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
     EmbeddingLayer, BaseOutputLayerConf,
 )
+from .convolution import (
+    ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
+    Subsampling1DLayer, ZeroPaddingLayer, ConvolutionMode, PoolingType,
+)
+from .normalization import BatchNormalization, LocalResponseNormalization
+from .pooling import GlobalPoolingLayer
 
 __all__ = [
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
     "DropoutLayer", "EmbeddingLayer", "BaseOutputLayerConf",
+    "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
+    "Subsampling1DLayer", "ZeroPaddingLayer", "ConvolutionMode",
+    "PoolingType", "BatchNormalization", "LocalResponseNormalization",
+    "GlobalPoolingLayer",
 ]

@@ -1,0 +1,339 @@
+"""Convolution + pooling + padding layers.
+
+Reference parity:
+  * ConvolutionLayer — `nn/conf/layers/ConvolutionLayer.java` +
+    `nn/layers/convolution/ConvolutionLayer.java:52` (im2col-based) and the
+    cuDNN helper `deeplearning4j-cuda/.../CudnnConvolutionHelper.java:49`.
+    TPU-native: one `lax.conv_general_dilated` call in NHWC/HWIO layout —
+    XLA tiles it straight onto the MXU; no im2col, no helper SPI, no
+    algorithm selection (XLA picks).
+  * Convolution1DLayer — `nn/conf/layers/Convolution1DLayer.java`
+  * SubsamplingLayer (+1D) — `nn/conf/layers/SubsamplingLayer.java`,
+    `nn/layers/convolution/subsampling/SubsamplingLayer.java`,
+    `CudnnSubsamplingHelper.java` → `lax.reduce_window`.
+  * ZeroPaddingLayer — `nn/conf/layers/ZeroPaddingLayer.java`
+  * ConvolutionMode — `nn/conf/ConvolutionMode.java` (Strict/Truncate/Same)
+
+Data layout is NHWC ([batch, height, width, channels]) vs the reference's
+NCHW — the TPU-preferred layout.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+
+__all__ = [
+    "ConvolutionMode", "PoolingType", "ConvolutionLayer", "Convolution1DLayer",
+    "SubsamplingLayer", "Subsampling1DLayer", "ZeroPaddingLayer",
+]
+
+
+class ConvolutionMode:
+    STRICT = "strict"
+    TRUNCATE = "truncate"
+    SAME = "same"
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv_output_size(size: int, k: int, s: int, mode: str, dilation: int = 1) -> int:
+    """Output spatial extent (reference `util/ConvolutionUtils.java`)."""
+    eff_k = k + (k - 1) * (dilation - 1)
+    if mode == ConvolutionMode.SAME:
+        return int(math.ceil(size / s))
+    if mode == ConvolutionMode.STRICT:
+        if (size - eff_k) % s != 0:
+            raise ValueError(
+                f"ConvolutionMode.STRICT: (size={size} - kernel={eff_k}) not "
+                f"divisible by stride={s}. Use TRUNCATE or SAME.")
+        return (size - eff_k) // s + 1
+    # TRUNCATE
+    return (size - eff_k) // s + 1
+
+
+def _xla_padding(mode: str):
+    return "SAME" if mode == ConvolutionMode.SAME else "VALID"
+
+
+@register_layer
+@dataclass
+class ConvolutionLayer(LayerConf):
+    """2-D convolution, NHWC. W: [kh, kw, c_in, n_out]."""
+
+    input_kind = "cnn"
+
+    n_in: Optional[int] = None          # input channels (inferred)
+    n_out: int = 0                      # filters
+    kernel_size: Sequence[int] = (5, 5)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)     # explicit padding (used when mode != SAME)
+    dilation: Sequence[int] = (1, 1)
+    convolution_mode: str = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def fill_from_input_type(self, it: InputType):
+        if it.kind == "cnn" and not self.n_in:
+            return {"n_in": it.channels}
+        return {}
+
+    def n_in_from(self, it: InputType) -> int:
+        return it.channels if it.kind == "cnn" else it.flat_size()
+
+    def _dims(self):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        return kh, kw, sh, sw, ph, pw, dh, dw
+
+    def output_type(self, it: InputType) -> InputType:
+        kh, kw, sh, sw, ph, pw, dh, dw = self._dims()
+        if self.convolution_mode == ConvolutionMode.SAME:
+            oh = conv_output_size(it.height, kh, sh, ConvolutionMode.SAME, dh)
+            ow = conv_output_size(it.width, kw, sw, ConvolutionMode.SAME, dw)
+        else:
+            oh = conv_output_size(it.height + 2 * ph, kh, sh,
+                                  self.convolution_mode, dh)
+            ow = conv_output_size(it.width + 2 * pw, kw, sw,
+                                  self.convolution_mode, dw)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        kh, kw, *_ = self._dims()
+        c_in = self.n_in or it.channels
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * self.n_out
+        p = {"W": self._winit(rng, (kh, kw, c_in, self.n_out),
+                              fan_in=fan_in, fan_out=fan_out)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        kh, kw, sh, sw, ph, pw, dh, dw = self._dims()
+        if self.convolution_mode == ConvolutionMode.SAME:
+            padding = "SAME"
+        else:
+            padding = ((ph, ph), (pw, pw))
+        # lax.conv requires equal dtypes; follow numpy promotion (matches the
+        # implicit promotion dense layers get from jnp.dot)
+        ct = jnp.result_type(x.dtype, params["W"].dtype)
+        z = lax.conv_general_dilated(
+            x.astype(ct), params["W"].astype(ct), window_strides=(sh, sw),
+            padding=padding, rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclass
+class Convolution1DLayer(LayerConf):
+    """1-D convolution over time: input [B, T, F] (reference
+    `nn/conf/layers/Convolution1DLayer.java`; layout [B,F,T] there)."""
+
+    input_kind = "rnn"
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: str = ConvolutionMode.SAME
+    has_bias: bool = True
+
+    def n_in_from(self, it: InputType) -> int:
+        return it.size
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timesteps
+        if t is not None:
+            if self.convolution_mode == ConvolutionMode.SAME:
+                t = conv_output_size(t, self.kernel_size, self.stride,
+                                     ConvolutionMode.SAME, self.dilation)
+            else:
+                t = conv_output_size(t + 2 * self.padding, self.kernel_size,
+                                     self.stride, self.convolution_mode,
+                                     self.dilation)
+        return InputType.recurrent(self.n_out, t)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        c_in = self.n_in or it.size
+        k = self.kernel_size
+        p = {"W": self._winit(rng, (k, c_in, self.n_out),
+                              fan_in=k * c_in, fan_out=k * self.n_out)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        if self.convolution_mode == ConvolutionMode.SAME:
+            padding = "SAME"
+        else:
+            padding = ((self.padding, self.padding),)
+        ct = jnp.result_type(x.dtype, params["W"].dtype)
+        z = lax.conv_general_dilated(
+            x.astype(ct), params["W"].astype(ct),
+            window_strides=(self.stride,), padding=padding,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NHC", "HIO", "NHC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclass
+class SubsamplingLayer(LayerConf):
+    """2-D pooling (max/avg/sum/pnorm), NHWC."""
+
+    input_kind = "cnn"
+
+    pooling_type: str = PoolingType.MAX
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+    eps: float = 1e-8
+
+    def output_type(self, it: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.convolution_mode == ConvolutionMode.SAME:
+            oh = conv_output_size(it.height, kh, sh, ConvolutionMode.SAME)
+            ow = conv_output_size(it.width, kw, sw, ConvolutionMode.SAME)
+        else:
+            oh = conv_output_size(it.height + 2 * ph, kh, sh, self.convolution_mode)
+            ow = conv_output_size(it.width + 2 * pw, kw, sw, self.convolution_mode)
+        return InputType.convolutional(oh, ow, it.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.convolution_mode == ConvolutionMode.SAME:
+            pads = "SAME"
+        else:
+            pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        return _pool(x, self.pooling_type, window, strides, pads,
+                     self.pnorm, self.eps), state
+
+
+@register_layer
+@dataclass
+class Subsampling1DLayer(LayerConf):
+    """1-D pooling over time: [B, T, F]."""
+
+    input_kind = "rnn"
+
+    pooling_type: str = PoolingType.MAX
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: str = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+    eps: float = 1e-8
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timesteps
+        if t is not None:
+            if self.convolution_mode == ConvolutionMode.SAME:
+                t = conv_output_size(t, self.kernel_size, self.stride,
+                                     ConvolutionMode.SAME)
+            else:
+                t = conv_output_size(t + 2 * self.padding, self.kernel_size,
+                                     self.stride, self.convolution_mode)
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.convolution_mode == ConvolutionMode.SAME:
+            pads = "SAME"
+        else:
+            pads = ((0, 0), (self.padding, self.padding), (0, 0))
+        return _pool(x, self.pooling_type, (1, self.kernel_size, 1),
+                     (1, self.stride, 1), pads, self.pnorm, self.eps), state
+
+
+def _pool(x, pooling_type, window, strides, pads, pnorm, eps):
+    if pads == "SAME":
+        padding = "SAME"
+    else:
+        padding = pads
+    if pooling_type == PoolingType.MAX:
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+    if pooling_type == PoolingType.SUM:
+        return lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+    if pooling_type == PoolingType.AVG:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / counts
+    if pooling_type == PoolingType.PNORM:
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
+                              padding)
+        return (s + eps) ** (1.0 / p)
+    raise ValueError(f"Unknown pooling type '{pooling_type}'")
+
+
+@register_layer
+@dataclass
+class ZeroPaddingLayer(LayerConf):
+    """Zero-pads H/W (reference `nn/conf/layers/ZeroPaddingLayer.java`).
+    pad = (top, bottom, left, right) or (h, w)."""
+
+    input_kind = "cnn"
+
+    pad: Sequence[int] = (1, 1)
+
+    def _pads(self):
+        p = tuple(int(v) for v in self.pad)
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        if len(p) == 4:
+            return p
+        raise ValueError("pad must be (h,w) or (top,bottom,left,right)")
+
+    def output_type(self, it: InputType) -> InputType:
+        t, b, l, r = self._pads()
+        return InputType.convolutional(it.height + t + b, it.width + l + r,
+                                       it.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state

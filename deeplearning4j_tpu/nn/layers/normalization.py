@@ -1,0 +1,116 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference parity:
+  * BatchNormalization — `nn/conf/layers/BatchNormalization.java` +
+    `nn/layers/normalization/BatchNormalization.java:38` and the cuDNN helper
+    `CudnnBatchNormalizationHelper.java`. TPU-native: plain jnp moment math —
+    XLA fuses normalize+scale+shift into neighbors (the role of the fused
+    cuDNN kernel). Running mean/var live in layer *state* (the reference
+    stores them as non-updated params).
+  * LocalResponseNormalization — `nn/conf/layers/LocalResponseNormalization.java`
+    + `nn/layers/normalization/LocalResponseNormalization.java` and
+    `CudnnLocalResponseNormalizationHelper.java`. Cross-channel as in the
+    reference (NHWC: window over the last axis).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+
+__all__ = ["BatchNormalization", "LocalResponseNormalization"]
+
+
+@register_layer
+@dataclass
+class BatchNormalization(LayerConf):
+    """Works on FF [B,F] (normalizes over batch) and CNN NHWC [B,H,W,C]
+    (normalizes over batch+spatial, per channel)."""
+
+    input_kind = "any"
+
+    n_out: Optional[int] = None     # feature/channel count (inferred)
+    decay: float = 0.9              # running-average momentum
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lock_gamma_beta: bool = False   # reference lockGammaBeta: fixed scale/shift
+
+    def _nf(self, it: InputType) -> int:
+        if self.n_out:
+            return self.n_out
+        return it.channels if it.kind == "cnn" else it.flat_size()
+
+    def fill_from_input_type(self, it: InputType):
+        return {"n_out": self._nf(it)} if not self.n_out else {}
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    @property
+    def has_params(self) -> bool:
+        return not self.lock_gamma_beta
+
+    def init_params(self, rng, it: InputType):
+        if self.lock_gamma_beta:
+            return {}
+        nf = self._nf(it)
+        return {"gamma": jnp.full((nf,), self.gamma_init, jnp.float32),
+                "beta": jnp.full((nf,), self.beta_init, jnp.float32)}
+
+    def init_state(self, it: InputType):
+        nf = self._nf(it)
+        return {"mean": jnp.zeros((nf,), jnp.float32),
+                "var": jnp.ones((nf,), jnp.float32)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but feature/channel axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        else:
+            xhat = xhat * self.gamma_init + self.beta_init
+        return self._act(xhat), new_state
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(LayerConf):
+    """Cross-channel LRN: y = x / (k + alpha*sum_{nearby ch} x^2)^beta.
+    Defaults match the reference (k=2, n=5, alpha=1e-4, beta=0.75)."""
+
+    input_kind = "cnn"
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        # sum over a window of `n` adjacent channels (last axis)
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        strides = (1,) * x.ndim
+        pads = tuple((0, 0) for _ in range(x.ndim - 1)) + ((half, half),)
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pads)
+        denom = (self.k + self.alpha * ssum) ** self.beta
+        return x / denom, state

@@ -1,0 +1,76 @@
+"""GlobalPoolingLayer.
+
+Reference parity: `nn/conf/layers/GlobalPoolingLayer.java` +
+`nn/layers/pooling/GlobalPoolingLayer.java` — pools over time ([B,T,F]→[B,F])
+or spatial dims (NHWC [B,H,W,C]→[B,C]), with masking support for variable-
+length sequences (reference uses `util/MaskedReductionUtil.java`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+from .convolution import PoolingType
+
+__all__ = ["GlobalPoolingLayer"]
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(LayerConf):
+    input_kind = "any"
+
+    pooling_type: str = PoolingType.MAX
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+    eps: float = 1e-8
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind in ("rnn", "cnn1d"):
+            return InputType.feed_forward(it.size)
+        if it.kind == "cnn":
+            return InputType.feed_forward(it.channels)
+        return it
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim == 3:       # [B, T, F] over time
+            axes = (1,)
+        elif x.ndim == 4:     # [B, H, W, C] over space
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling expects 3-D/4-D input, got {x.ndim}-D")
+
+        pt = self.pooling_type
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[:, :, None]  # [B, T, 1]
+            if pt == PoolingType.MAX:
+                neg = jnp.where(m > 0, x, -jnp.inf)
+                out = jnp.max(neg, axis=1)
+            elif pt == PoolingType.SUM:
+                out = jnp.sum(x * m, axis=1)
+            elif pt == PoolingType.AVG:
+                out = jnp.sum(x * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+            elif pt == PoolingType.PNORM:
+                p = float(self.pnorm)
+                out = (jnp.sum((jnp.abs(x) ** p) * m, axis=1) + self.eps) ** (1 / p)
+            else:
+                raise ValueError(f"Unknown pooling type '{pt}'")
+            return out, state
+
+        if pt == PoolingType.MAX:
+            out = jnp.max(x, axis=axes)
+        elif pt == PoolingType.SUM:
+            out = jnp.sum(x, axis=axes)
+        elif pt == PoolingType.AVG:
+            out = jnp.mean(x, axis=axes)
+        elif pt == PoolingType.PNORM:
+            p = float(self.pnorm)
+            out = (jnp.sum(jnp.abs(x) ** p, axis=axes) + self.eps) ** (1 / p)
+        else:
+            raise ValueError(f"Unknown pooling type '{pt}'")
+        return out, state

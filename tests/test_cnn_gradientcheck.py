@@ -1,0 +1,106 @@
+"""CNN gradient checks (reference: CNNGradientCheckTest.java,
+CNN1DGradientCheckTest.java, BNGradientCheckTest.java, LRNGradientCheckTests.java,
+GlobalPoolingGradientCheckTests.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Convolution1DLayer, ConvolutionLayer,
+                                ConvolutionMode, DataSet, DenseLayer,
+                                GlobalPoolingLayer, GradientCheckUtil,
+                                InputType, LocalResponseNormalization,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, PoolingType, Sgd,
+                                Subsampling1DLayer, SubsamplingLayer,
+                                ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer as GP
+
+
+def _net(layers, input_type, seed=12345):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list()
+    for l in layers:
+        b.layer(l)
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+def _cls_data(shape, n_out, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=shape)
+    idx = r.integers(0, n_out, shape[0])
+    y = np.zeros((shape[0], n_out)); y[np.arange(shape[0]), idx] = 1.0
+    return DataSet(x, y)
+
+
+@pytest.mark.parametrize("mode", [ConvolutionMode.TRUNCATE, ConvolutionMode.SAME])
+def test_conv2d_gradients(mode):
+    net = _net([
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(1, 1),
+                         activation="tanh", convolution_mode=mode),
+        SubsamplingLayer(pooling_type=PoolingType.MAX, kernel_size=(2, 2),
+                         stride=(2, 2)),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.convolutional(6, 6, 2))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((5, 6, 6, 2), 2))
+
+
+@pytest.mark.parametrize("pt", [PoolingType.MAX, PoolingType.AVG,
+                                PoolingType.SUM, PoolingType.PNORM])
+def test_pooling_gradients(pt):
+    net = _net([
+        ConvolutionLayer(n_out=2, kernel_size=(2, 2), activation="sigmoid"),
+        SubsamplingLayer(pooling_type=pt, kernel_size=(2, 2), stride=(1, 1)),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.convolutional(5, 5, 1))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((4, 5, 5, 1), 2))
+
+
+def test_lrn_gradients():
+    net = _net([
+        ConvolutionLayer(n_out=6, kernel_size=(2, 2), activation="tanh"),
+        LocalResponseNormalization(),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.convolutional(5, 5, 1))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((4, 5, 5, 1), 2))
+
+
+def test_zero_padding_gradients():
+    net = _net([
+        ZeroPaddingLayer(pad=(1, 1)),
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="tanh"),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.convolutional(5, 5, 1))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((4, 5, 5, 1), 2))
+
+
+@pytest.mark.parametrize("pt", [PoolingType.MAX, PoolingType.AVG, PoolingType.PNORM])
+def test_global_pooling_cnn_gradients(pt):
+    net = _net([
+        ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+        GlobalPoolingLayer(pooling_type=pt),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.convolutional(5, 5, 1))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((4, 5, 5, 1), 2))
+
+
+def test_conv1d_gradients():
+    net = _net([
+        Convolution1DLayer(n_out=3, kernel_size=3, activation="tanh",
+                           convolution_mode=ConvolutionMode.SAME),
+        Subsampling1DLayer(pooling_type=PoolingType.MAX, kernel_size=2, stride=2),
+        GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+    ], InputType.recurrent(4, 8))
+    assert GradientCheckUtil.check_gradients(net, _cls_data((3, 8, 4), 2))
+
+
+def test_embedding_gradients():
+    from deeplearning4j_tpu import EmbeddingLayer
+    net = _net([
+        EmbeddingLayer(n_in=7, n_out=5, activation="tanh"),
+        DenseLayer(n_out=4, activation="relu"),
+        OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+    ], InputType.feed_forward(1))
+    r = np.random.default_rng(0)
+    x = r.integers(0, 7, size=(6, 1)).astype(np.float64)
+    idx = r.integers(0, 3, 6)
+    y = np.zeros((6, 3)); y[np.arange(6), idx] = 1.0
+    assert GradientCheckUtil.check_gradients(net, DataSet(x, y))

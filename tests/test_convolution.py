@@ -1,0 +1,173 @@
+"""CNN layer tests: shape inference, conv/pool/BN/LRN behavior, LeNet
+end-to-end (reference: ConvolutionLayerTest, SubsamplingLayerTest,
+BatchNormalizationTest, ConvolutionLayerSetupTest in deeplearning4j-core)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ArrayDataSetIterator, BatchNormalization,
+                                ConvolutionLayer, ConvolutionMode, DataSet,
+                                DenseLayer, GlobalPoolingLayer, InputType,
+                                LocalResponseNormalization,
+                                MultiLayerConfiguration, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer,
+                                PoolingType, Sgd, SubsamplingLayer,
+                                ZeroPaddingLayer, Adam)
+from deeplearning4j_tpu.models.zoo import lenet_mnist
+from deeplearning4j_tpu.nn.layers.convolution import conv_output_size
+
+
+def test_conv_output_size_modes():
+    assert conv_output_size(28, 5, 1, ConvolutionMode.TRUNCATE) == 24
+    assert conv_output_size(28, 5, 1, ConvolutionMode.SAME) == 28
+    assert conv_output_size(28, 2, 2, ConvolutionMode.STRICT) == 14
+    with pytest.raises(ValueError):
+        conv_output_size(28, 5, 2, ConvolutionMode.STRICT)
+    assert conv_output_size(28, 5, 2, ConvolutionMode.TRUNCATE) == 12
+
+
+def test_lenet_shape_inference():
+    model = lenet_mnist()
+    layers = model.conf.layers
+    # conv1 gets 1 input channel, conv2 gets 20
+    assert layers[0].n_in == 1
+    assert layers[2].n_in == 20
+    # dense n_in = 4*4*50 (28->24->12->8->4)
+    assert layers[4].n_in == 4 * 4 * 50
+    assert layers[5].n_in == 500
+    # preprocessors: FF->CNN at 0, CNN->FF at 4
+    assert 0 in model.conf.preprocessors
+    assert 4 in model.conf.preprocessors
+
+
+def test_lenet_json_roundtrip():
+    model = lenet_mnist()
+    js = model.conf.to_json()
+    back = MultiLayerConfiguration.from_json(js)
+    assert back.to_json() == js
+
+
+def test_lenet_trains_on_synthetic():
+    # tiny synthetic "mnist": each class = distinct blob position
+    r = np.random.default_rng(0)
+    n, n_classes = 400, 4
+    ys = r.integers(0, n_classes, n)
+    x = np.zeros((n, 28, 28), np.float32)
+    for i, c in enumerate(ys):
+        rr, cc = 5 + 4 * (c % 2) * 2, 5 + 4 * (c // 2) * 2
+        x[i, rr:rr + 6, cc:cc + 6] = 1.0
+    x += r.normal(0, 0.1, x.shape).astype(np.float32)
+    x = x.reshape(n, 784)
+    y = np.eye(10, dtype=np.float32)[ys]
+
+    model = lenet_mnist(updater=Adam(1e-3)).init()
+    model.fit(ArrayDataSetIterator(x, y, batch_size=64, shuffle=True, seed=1),
+              epochs=3)
+    ev = model.evaluate(ArrayDataSetIterator(x, y, batch_size=128))
+    assert ev.accuracy() > 0.95, ev.stats()
+
+
+def _cnn_net(*mid_layers, h=8, w=8, c=2, n_out=3, seed=12345):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list())
+    for l in mid_layers:
+        b.layer(l)
+    b.layer(OutputLayer(n_out=n_out, activation="softmax", loss="mcxent"))
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.convolutional(h, w, c)).build()).init()
+
+
+def _cnn_data(n=6, h=8, w=8, c=2, n_out=3, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, h, w, c))
+    idx = r.integers(0, n_out, n)
+    y = np.zeros((n, n_out)); y[np.arange(n), idx] = 1.0
+    return DataSet(x, y)
+
+
+def test_conv_same_mode_shapes():
+    net = _cnn_net(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    convolution_mode=ConvolutionMode.SAME,
+                                    activation="relu"))
+    ds = _cnn_data()
+    out = net.output(ds.features)
+    assert out.shape == (6, 3)
+
+
+def test_pooling_types():
+    for pt in [PoolingType.MAX, PoolingType.AVG, PoolingType.SUM, PoolingType.PNORM]:
+        net = _cnn_net(
+            ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="tanh"),
+            SubsamplingLayer(pooling_type=pt, kernel_size=(2, 2), stride=(2, 2)))
+        out = net.output(_cnn_data().features)
+        assert out.shape == (6, 3)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+def test_avg_pool_value():
+    import jax.numpy as jnp
+    layer = SubsamplingLayer(pooling_type=PoolingType.AVG, kernel_size=(2, 2),
+                             stride=(2, 2))
+    x = jnp.arange(16, dtype=jnp.float64).reshape(1, 4, 4, 1)
+    out, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(out)[0, :, :, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_zero_padding():
+    import jax.numpy as jnp
+    layer = ZeroPaddingLayer(pad=(1, 2))
+    x = jnp.ones((1, 4, 4, 3))
+    out, _ = layer.apply({}, {}, x)
+    assert out.shape == (1, 6, 8, 3)
+    assert float(out[0, 0, 0, 0]) == 0.0
+    it = layer.output_type(InputType.convolutional(4, 4, 3))
+    assert (it.height, it.width) == (6, 8)
+
+
+def test_batchnorm_normalizes_and_tracks_running_stats():
+    import jax.numpy as jnp
+    bn = BatchNormalization(n_out=3, decay=0.5)
+    rng_np = np.random.default_rng(0)
+    x = jnp.asarray(rng_np.normal(5.0, 2.0, (64, 3)))
+    params = bn.init_params(None, InputType.feed_forward(3))
+    state = bn.init_state(InputType.feed_forward(3))
+    out, new_state = bn.apply(params, state, x, train=True)
+    # normalized output ~ zero-mean unit-var
+    assert abs(float(jnp.mean(out))) < 0.1
+    assert abs(float(jnp.std(out)) - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert np.all(np.asarray(new_state["mean"]) > 1.0)
+    # inference mode uses running stats, doesn't change state
+    out2, state2 = bn.apply(params, new_state, x, train=False)
+    assert state2 is new_state
+
+
+def test_batchnorm_in_network_gradcheck():
+    from deeplearning4j_tpu import GradientCheckUtil
+    net = _cnn_net(
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), activation="identity"),
+        BatchNormalization(activation="relu"),
+        GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+        h=6, w=6, c=2)
+    ds = _cnn_data(h=6, w=6)
+    assert GradientCheckUtil.check_gradients(net, ds)
+
+
+def test_lrn_shape_and_value():
+    import jax.numpy as jnp
+    lrn = LocalResponseNormalization()
+    x = jnp.ones((2, 4, 4, 8))
+    out, _ = lrn.apply({}, {}, x)
+    assert out.shape == x.shape
+    # uniform input: denom = (k + alpha * window_count)^beta
+    expected = 1.0 / (2.0 + 1e-4 * 5) ** 0.75
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 4], expected, rtol=1e-4)
+
+
+def test_global_pooling_masked():
+    import jax.numpy as jnp
+    gp = GlobalPoolingLayer(pooling_type=PoolingType.AVG)
+    x = jnp.asarray(np.arange(24, dtype=np.float64).reshape(2, 3, 4))
+    mask = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    out, _ = gp.apply({}, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(out)[0], (x[0, 0] + x[0, 1]) / 2)
+    np.testing.assert_allclose(np.asarray(out)[1], x[1, 0])
