@@ -16,13 +16,19 @@ from .nn import (BackpropType, GradientNormalization, InputType,
                  MultiLayerConfiguration, MultiLayerNetwork,
                  NeuralNetConfiguration, NeuralNetConfigurationBuilder,
                  OptimizationAlgorithm)
-from .nn.layers import (ActivationLayer, BatchNormalization,
+from .nn.layers import (ActivationLayer, AutoEncoder, BatchNormalization,
+                        BernoulliReconstructionDistribution,
+                        CenterLossOutputLayer,
+                        CompositeReconstructionDistribution,
                         Convolution1DLayer, ConvolutionLayer, ConvolutionMode,
                         DenseLayer, DropoutLayer, EmbeddingLayer,
-                        GlobalPoolingLayer, LocalResponseNormalization,
-                        LossLayer, OutputLayer, PoolingType,
+                        GaussianReconstructionDistribution,
+                        GlobalPoolingLayer, GravesBidirectionalLSTM,
+                        GravesLSTM, LocalResponseNormalization,
+                        LossFunctionWrapper, LossLayer, OutputLayer,
+                        PoolingType, RBM, RnnOutputLayer,
                         Subsampling1DLayer, SubsamplingLayer,
-                        ZeroPaddingLayer)
+                        VariationalAutoencoder, ZeroPaddingLayer)
 from .nn.updaters import (AdaDelta, AdaGrad, Adam, AdaMax, Nesterovs, NoOp,
                           RmsProp, Sgd)
 from .nn.weights import Distribution, WeightInit
@@ -34,11 +40,16 @@ __all__ = [
     "BackpropType", "GradientNormalization", "InputType",
     "MultiLayerConfiguration", "MultiLayerNetwork", "NeuralNetConfiguration",
     "NeuralNetConfigurationBuilder", "OptimizationAlgorithm",
-    "ActivationLayer", "BatchNormalization", "Convolution1DLayer",
+    "ActivationLayer", "AutoEncoder", "BatchNormalization",
+    "BernoulliReconstructionDistribution", "CenterLossOutputLayer",
+    "CompositeReconstructionDistribution", "Convolution1DLayer",
     "ConvolutionLayer", "ConvolutionMode", "DenseLayer", "DropoutLayer",
-    "EmbeddingLayer", "GlobalPoolingLayer", "LocalResponseNormalization",
-    "LossLayer", "OutputLayer", "PoolingType", "Subsampling1DLayer",
-    "SubsamplingLayer", "ZeroPaddingLayer",
+    "EmbeddingLayer", "GaussianReconstructionDistribution",
+    "GlobalPoolingLayer", "GravesBidirectionalLSTM", "GravesLSTM",
+    "LocalResponseNormalization", "LossFunctionWrapper", "LossLayer",
+    "OutputLayer", "PoolingType", "RBM", "RnnOutputLayer",
+    "Subsampling1DLayer", "SubsamplingLayer", "VariationalAutoencoder",
+    "ZeroPaddingLayer",
     "AdaDelta", "AdaGrad", "Adam", "AdaMax", "Nesterovs", "NoOp", "RmsProp",
     "Sgd", "Distribution", "WeightInit",
     "ArrayDataSetIterator", "DataSet", "DataSetIterator", "Evaluation",

@@ -16,7 +16,8 @@ from ..nn.layers import (ConvolutionLayer, ConvolutionMode, DenseLayer,
 from ..nn.multilayer import MultiLayerNetwork
 from ..nn.updaters import Adam, Nesterovs
 
-__all__ = ["lenet_mnist", "bench_lenet", "mlp_mnist"]
+__all__ = ["lenet_mnist", "bench_lenet", "mlp_mnist", "char_rnn",
+           "bench_char_rnn"]
 
 
 def lenet_mnist(seed: int = 42, updater=None) -> MultiLayerNetwork:
@@ -51,6 +52,53 @@ def mlp_mnist(seed: int = 42) -> MultiLayerNetwork:
             .set_input_type(InputType.feed_forward(784))
             .build())
     return MultiLayerNetwork(conf)
+
+
+def char_rnn(vocab_size: int = 77, lstm_size: int = 200, seq_len: int = 64,
+             seed: int = 42, tbptt: int = 50) -> MultiLayerNetwork:
+    """GravesLSTM char-RNN (BASELINE config #3) — the reference's
+    char-modelling example topology: 2xLSTM + RnnOutputLayer, TBPTT."""
+    from ..nn.conf import BackpropType
+    from ..nn.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(2e-3))
+            .list()
+            .layer(GravesLSTM(n_out=lstm_size, activation="tanh"))
+            .layer(GravesLSTM(n_out=lstm_size, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab_size, seq_len))
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(tbptt)
+            .t_bptt_backward_length(tbptt)
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 20,
+                   warmup: int = 3, vocab: int = 77):
+    """tokens/sec for char-RNN training (BASELINE config #3)."""
+    import jax
+
+    from ..datasets.iterators import DataSet
+
+    model = char_rnn(vocab_size=vocab, seq_len=seq_len).init()
+    r = np.random.default_rng(0)
+    idx = r.integers(0, vocab, (batch, seq_len))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+    return batch * seq_len * steps / dt, "charRNN-tokens"
 
 
 def bench_lenet(batch: int = 512, steps: int = 40, warmup: int = 5):

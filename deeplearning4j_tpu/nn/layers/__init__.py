@@ -9,6 +9,14 @@ from .convolution import (
 )
 from .normalization import BatchNormalization, LocalResponseNormalization
 from .pooling import GlobalPoolingLayer
+from .recurrent import (GravesLSTM, GravesBidirectionalLSTM, RnnOutputLayer,
+                        BaseRecurrentLayer)
+from .generative import (AutoEncoder, RBM, VariationalAutoencoder,
+                         CenterLossOutputLayer,
+                         GaussianReconstructionDistribution,
+                         BernoulliReconstructionDistribution,
+                         CompositeReconstructionDistribution,
+                         LossFunctionWrapper)
 
 __all__ = [
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
@@ -17,4 +25,9 @@ __all__ = [
     "Subsampling1DLayer", "ZeroPaddingLayer", "ConvolutionMode",
     "PoolingType", "BatchNormalization", "LocalResponseNormalization",
     "GlobalPoolingLayer",
+    "GravesLSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
+    "BaseRecurrentLayer",
+    "AutoEncoder", "RBM", "VariationalAutoencoder", "CenterLossOutputLayer",
+    "GaussianReconstructionDistribution", "BernoulliReconstructionDistribution",
+    "CompositeReconstructionDistribution", "LossFunctionWrapper",
 ]

@@ -1,0 +1,488 @@
+"""Generative / pretraining layers: AutoEncoder, RBM, VariationalAutoencoder,
+CenterLossOutputLayer.
+
+Reference parity:
+  * AutoEncoder — `nn/conf/layers/AutoEncoder.java` +
+    `nn/layers/feedforward/autoencoder/AutoEncoder.java`: denoising AE with
+    corruption, W/hidden-bias/visible-bias params, decode via W^T.
+  * RBM — `nn/conf/layers/RBM.java` + `nn/layers/feedforward/rbm/RBM.java`:
+    contrastive divergence (CD-k) pretraining; BINARY/GAUSSIAN visible and
+    hidden units. CD gradients are computed directly (positive phase minus
+    negative phase) — not via jax.grad — matching the reference's algorithm.
+  * VariationalAutoencoder — `nn/conf/layers/variational/` +
+    `nn/layers/variational/VariationalAutoencoder.java:48`: encoder/decoder
+    MLPs, reparameterization, reconstruction distributions (Gaussian,
+    Bernoulli, Composite, LossFunctionWrapper), -ELBO pretrain loss.
+  * CenterLossOutputLayer — `nn/conf/layers/CenterLossOutputLayer.java` +
+    `nn/layers/training/CenterLossOutputLayer.java`: softmax CE +
+    lambda/2*||features - center_{y}||^2. Deviation: centers are trained by
+    gradient descent on the center term scaled by `alpha` (the reference uses
+    an exponential-moving-average center update); same fixed point.
+
+Pretraining protocol (consumed by `MultiLayerNetwork.pretrain`):
+    layer.is_pretrainable -> bool
+    layer.pretrain_value_and_grad(params, x, rng) -> (score, grads_dict)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _activations
+from .. import losses as _losses
+from ..conf.base import LayerConf, register_layer, register_aux_dataclass
+from ..conf.input_type import InputType
+from .feedforward import BaseOutputLayerConf
+
+__all__ = [
+    "AutoEncoder", "RBM", "VariationalAutoencoder", "CenterLossOutputLayer",
+    "GaussianReconstructionDistribution", "BernoulliReconstructionDistribution",
+    "CompositeReconstructionDistribution", "LossFunctionWrapper",
+]
+
+
+# ---------------------------------------------------------------------------
+# AutoEncoder
+# ---------------------------------------------------------------------------
+
+@register_layer
+@dataclass
+class AutoEncoder(LayerConf):
+    n_in: Optional[int] = None
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    pretrain_loss: str = "mse"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "sigmoid"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    @property
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        n_in = self.n_in or it.flat_size()
+        return {"W": self._winit(rng, (n_in, self.n_out),
+                                 fan_in=n_in, fan_out=self.n_out),
+                "b": self._binit((self.n_out,)),
+                "vb": self._binit((n_in,))}
+
+    def encode(self, params, x):
+        return self._act(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self._act(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        return self.encode(params, x), state
+
+    def pretrain_value_and_grad(self, params, x, rng):
+        def loss(p):
+            xin = x
+            if self.corruption_level > 0 and rng is not None:
+                keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level,
+                                            x.shape)
+                xin = jnp.where(keep, x, 0.0)
+            h = self.encode(p, xin)
+            recon = self.decode(p, h)
+            l = _losses.get(self.pretrain_loss).score(x, recon,
+                                                      activation="identity")
+            if self.sparsity > 0:
+                rho_hat = jnp.clip(jnp.mean(h, axis=0), 1e-6, 1 - 1e-6)
+                rho = self.sparsity
+                l = l + jnp.sum(rho * jnp.log(rho / rho_hat)
+                                + (1 - rho) * jnp.log((1 - rho) / (1 - rho_hat)))
+            return l
+        return jax.value_and_grad(loss)(params)
+
+
+# ---------------------------------------------------------------------------
+# RBM
+# ---------------------------------------------------------------------------
+
+@register_layer
+@dataclass
+class RBM(LayerConf):
+    """Restricted Boltzmann Machine with CD-k pretraining."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    hidden_unit: str = "binary"    # binary | rectified | gaussian
+    visible_unit: str = "binary"   # binary | gaussian
+    k: int = 1                     # CD-k gibbs steps
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "sigmoid"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    @property
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        n_in = self.n_in or it.flat_size()
+        return {"W": self._winit(rng, (n_in, self.n_out),
+                                 fan_in=n_in, fan_out=self.n_out),
+                "b": self._binit((self.n_out,)),      # hidden bias
+                "vb": self._binit((n_in,))}           # visible bias
+
+    def _prop_up(self, params, v):
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "rectified":
+            return jax.nn.relu(pre)
+        return jax.nn.sigmoid(pre)
+
+    def _prop_down(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "gaussian":
+            return pre
+        return jax.nn.sigmoid(pre)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        return self._prop_up(params, x), state
+
+    def pretrain_value_and_grad(self, params, x, rng):
+        """CD-k: grads = -(positive phase - negative phase) (descent form).
+        Score reported is the reconstruction MSE (the reference reports
+        reconstruction error for RBMs as well)."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        batch = x.shape[0]
+        h_prob = self._prop_up(params, x)
+        rngs = jax.random.split(rng, self.k + 1)
+        h_sample = (jax.random.bernoulli(rngs[0], h_prob)
+                    .astype(x.dtype) if self.hidden_unit == "binary" else h_prob)
+        v_neg = x
+        h_neg = h_sample
+        for i in range(self.k):
+            v_neg = self._prop_down(params, h_neg)
+            if self.visible_unit == "binary":
+                v_neg = jax.random.bernoulli(rngs[i + 1], v_neg).astype(x.dtype)
+            h_neg = self._prop_up(params, v_neg)
+        pos_W = x.T @ h_prob
+        neg_W = v_neg.T @ h_neg
+        grads = {
+            "W": -(pos_W - neg_W) / batch,
+            "b": -jnp.mean(h_prob - h_neg, axis=0),
+            "vb": -jnp.mean(x - v_neg, axis=0),
+        }
+        recon = self._prop_down(params, h_prob)
+        score = jnp.mean(jnp.sum((x - recon) ** 2, axis=-1))
+        return score, grads
+
+
+# ---------------------------------------------------------------------------
+# VAE reconstruction distributions
+# ---------------------------------------------------------------------------
+
+@register_aux_dataclass
+@dataclass
+class GaussianReconstructionDistribution:
+    """p(x|z) = N(mean, sigma^2); dist params per feature: [mean, log(sigma^2)]
+    (reference `GaussianReconstructionDistribution.java`)."""
+
+    activation: str = "identity"
+
+    params_per_feature = 2
+
+    def log_prob(self, x, dist_params):
+        n = x.shape[-1]
+        mean = _activations.get(self.activation)(dist_params[..., :n])
+        log_var = dist_params[..., n:]
+        var = jnp.exp(log_var)
+        return jnp.sum(-0.5 * (jnp.log(2 * jnp.pi) + log_var
+                               + (x - mean) ** 2 / var), axis=-1)
+
+    def sample_mean(self, dist_params, n):
+        return _activations.get(self.activation)(dist_params[..., :n])
+
+
+@register_aux_dataclass
+@dataclass
+class BernoulliReconstructionDistribution:
+    """p(x|z) = Bernoulli(sigmoid(logits)) (reference
+    `BernoulliReconstructionDistribution.java`)."""
+
+    activation: str = "sigmoid"
+
+    params_per_feature = 1
+
+    def log_prob(self, x, dist_params):
+        logits = dist_params
+        return jnp.sum(x * jax.nn.log_sigmoid(logits)
+                       + (1 - x) * jax.nn.log_sigmoid(-logits), axis=-1)
+
+    def sample_mean(self, dist_params, n):
+        return jax.nn.sigmoid(dist_params)
+
+
+@register_aux_dataclass
+@dataclass
+class CompositeReconstructionDistribution:
+    """Different distributions over feature ranges (reference
+    `CompositeReconstructionDistribution.java`). `parts` = list of
+    (n_features, distribution)."""
+
+    sizes: Sequence[int] = ()
+    dists: Sequence[object] = ()
+
+    @property
+    def params_per_feature(self):
+        raise AttributeError("composite: use total_params")
+
+    def total_params(self, n_features):
+        assert sum(self.sizes) == n_features
+        return sum(int(s) * d.params_per_feature
+                   for s, d in zip(self.sizes, self.dists))
+
+    def log_prob(self, x, dist_params):
+        lp = 0.0
+        xi = 0
+        pi = 0
+        for s, d in zip(self.sizes, self.dists):
+            np_ = s * d.params_per_feature
+            lp = lp + d.log_prob(x[..., xi:xi + s], dist_params[..., pi:pi + np_])
+            xi += s
+            pi += np_
+        return lp
+
+    def sample_mean(self, dist_params, n):
+        outs = []
+        pi = 0
+        for s, d in zip(self.sizes, self.dists):
+            np_ = s * d.params_per_feature
+            outs.append(d.sample_mean(dist_params[..., pi:pi + np_], s))
+            pi += np_
+        return jnp.concatenate(outs, axis=-1)
+
+
+@register_aux_dataclass
+@dataclass
+class LossFunctionWrapper:
+    """Use a plain loss as the reconstruction term (reference
+    `LossFunctionWrapper.java`)."""
+
+    loss: str = "mse"
+    activation: str = "identity"
+
+    params_per_feature = 1
+
+    def log_prob(self, x, dist_params):
+        per = _losses.get(self.loss).per_example(x, dist_params,
+                                                 activation=self.activation)
+        return -per
+
+    def sample_mean(self, dist_params, n):
+        return _activations.get(self.activation)(dist_params)
+
+
+# ---------------------------------------------------------------------------
+# Variational Autoencoder
+# ---------------------------------------------------------------------------
+
+@register_layer
+@dataclass
+class VariationalAutoencoder(LayerConf):
+    """VAE pretrain layer. In a supervised net, `apply` outputs the latent
+    mean (the reference's activate() does the same)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0                       # latent size n_z
+    encoder_layer_sizes: Sequence[int] = (100,)
+    decoder_layer_sizes: Sequence[int] = (100,)
+    reconstruction_distribution: object = field(
+        default_factory=BernoulliReconstructionDistribution)
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"   # hidden-layer activation
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    @property
+    def is_pretrainable(self) -> bool:
+        return True
+
+    def _recon_params_count(self, n_in):
+        d = self.reconstruction_distribution
+        if isinstance(d, CompositeReconstructionDistribution):
+            return d.total_params(n_in)
+        return n_in * d.params_per_feature
+
+    def init_params(self, rng, it: InputType):
+        n_in = self.n_in or it.flat_size()
+        sizes_e = [n_in] + list(self.encoder_layer_sizes)
+        sizes_d = [self.n_out] + list(self.decoder_layer_sizes)
+        n_recon = self._recon_params_count(n_in)
+        keys = jax.random.split(rng, len(sizes_e) + len(sizes_d) + 2)
+        p = {}
+        for i in range(len(sizes_e) - 1):
+            p[f"eW{i}"] = self._winit(keys[i], (sizes_e[i], sizes_e[i + 1]),
+                                      fan_in=sizes_e[i], fan_out=sizes_e[i + 1])
+            p[f"eb{i}"] = self._binit((sizes_e[i + 1],))
+        he = sizes_e[-1]
+        k = keys[len(sizes_e) - 1]
+        k1, k2 = jax.random.split(k)
+        p["zW"] = self._winit(k1, (he, 2 * self.n_out), fan_in=he,
+                              fan_out=2 * self.n_out)
+        p["zb"] = self._binit((2 * self.n_out,))
+        for i in range(len(sizes_d) - 1):
+            kk = keys[len(sizes_e) + i]
+            p[f"dW{i}"] = self._winit(kk, (sizes_d[i], sizes_d[i + 1]),
+                                      fan_in=sizes_d[i], fan_out=sizes_d[i + 1])
+            p[f"db{i}"] = self._binit((sizes_d[i + 1],))
+        hd = sizes_d[-1]
+        p["xW"] = self._winit(keys[-1], (hd, n_recon), fan_in=hd,
+                              fan_out=n_recon)
+        p["xb"] = self._binit((n_recon,))
+        return p
+
+    def _encode(self, params, x):
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = self._act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+        z2 = h @ params["zW"] + params["zb"]
+        mean, log_var = jnp.split(z2, 2, axis=-1)
+        mean = _activations.get(self.pzx_activation)(mean)
+        return mean, log_var
+
+    def _decode(self, params, z):
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = self._act(h @ params[f"dW{i}"] + params[f"db{i}"])
+        return h @ params["xW"] + params["xb"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def pretrain_value_and_grad(self, params, x, rng):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def loss(p):
+            mean, log_var = self._encode(p, x)
+            kl = -0.5 * jnp.sum(1 + log_var - mean ** 2 - jnp.exp(log_var),
+                                axis=-1)
+            rec = 0.0
+            keys = jax.random.split(rng, self.num_samples)
+            for s in range(self.num_samples):
+                eps = jax.random.normal(keys[s], mean.shape, mean.dtype)
+                z = mean + jnp.exp(0.5 * log_var) * eps
+                dist_params = self._decode(p, z)
+                rec = rec + self.reconstruction_distribution.log_prob(
+                    x, dist_params)
+            rec = rec / self.num_samples
+            return jnp.mean(kl - rec)   # -ELBO
+        return jax.value_and_grad(loss)(params)
+
+    def reconstruction_probability(self, params, x, rng, num_samples=5):
+        """Reference `reconstructionProbability` — importance-sampled estimate
+        used for anomaly detection."""
+        mean, log_var = self._encode(params, x)
+        keys = jax.random.split(rng, num_samples)
+        lps = []
+        for s in range(num_samples):
+            eps = jax.random.normal(keys[s], mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            dist_params = self._decode(params, z)
+            lps.append(self.reconstruction_distribution.log_prob(x, dist_params))
+        return jax.scipy.special.logsumexp(jnp.stack(lps), axis=0) - jnp.log(
+            float(num_samples))
+
+    def generate_at_mean_given_z(self, params, z):
+        n = self.n_in
+        return self.reconstruction_distribution.sample_mean(
+            self._decode(params, z), n)
+
+
+# ---------------------------------------------------------------------------
+# Center loss
+# ---------------------------------------------------------------------------
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(BaseOutputLayerConf):
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+    alpha: float = 0.05       # center learning-rate scaling
+    lambda_: float = 2e-4     # center-loss weight
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "softmax"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        n_in = self.n_in or it.flat_size()
+        p = {"W": self._winit(rng, (n_in, self.n_out),
+                              fan_in=n_in, fan_out=self.n_out),
+             "centers": jnp.zeros((self.n_out, n_in), jnp.float32)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def preout(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def loss_score(self, params, state, x, labels, *, train=False, rng=None,
+                   mask=None):
+        base = super().loss_score(params, state, x, labels, train=train,
+                                  rng=rng, mask=mask)
+        # center term: lambda/2 * ||x - c_y||^2 ; alpha scales the centers'
+        # effective learning rate (gradient-descent analog of the reference's
+        # EMA center update)
+        y_idx = jnp.argmax(labels, axis=-1)
+        c_y = params["centers"][y_idx]
+        # Two stop-gradient halves so the features see the full center term
+        # while the centers' gradient is scaled by alpha (their separate
+        # learning rate in the reference).
+        diff_for_features = x - jax.lax.stop_gradient(c_y)
+        diff_for_centers = jax.lax.stop_gradient(x) - c_y
+        center_term = 0.5 * self.lambda_ * jnp.mean(
+            jnp.sum(diff_for_features ** 2, axis=-1))
+        # zero-valued term whose gradient w.r.t. centers is alpha-scaled
+        cgrad_term = 0.5 * self.lambda_ * self.alpha * jnp.mean(
+            jnp.sum(diff_for_centers ** 2, axis=-1))
+        cgrad_term = cgrad_term - jax.lax.stop_gradient(cgrad_term)
+        return base + center_term + cgrad_term

@@ -1,0 +1,233 @@
+"""Recurrent layers: GravesLSTM, GravesBidirectionalLSTM, RnnOutputLayer.
+
+Reference parity:
+  * GravesLSTM — `nn/conf/layers/GravesLSTM.java` +
+    `nn/layers/recurrent/GravesLSTM.java:41` with the shared math in
+    `LSTMHelpers.java` (fwd `activateHelper`:57 with per-timestep hot loop
+    :161; bwd `backpropGradientHelper`:271, loop :333). Graves-style peephole
+    connections, forget-gate bias init 1.0.
+    TPU-native: ONE `lax.scan` over time — each step is a single [B, n_in+n_out]
+    x [n_in+n_out, 4*n_out] matmul on the MXU; backward comes from
+    differentiating the scan (no hand-written bwd loop).
+  * GravesBidirectionalLSTM — `nn/layers/recurrent/GravesBidirectionalLSTM.java:54`
+    (fwd + bwd passes concatenated).
+  * RnnOutputLayer — `nn/layers/recurrent/RnnOutputLayer.java`: time-distributed
+    loss head over [B, T, C] with per-timestep masking.
+
+Data layout: [batch, time, features] (reference uses [batch, features, time]).
+
+Masking: masked steps pass the previous (h, c) through unchanged and output
+zeros, matching the reference's masked-step semantics.
+
+Carry protocol (used by TBPTT + `rnn_time_step` stateful inference —
+`MultiLayerNetwork.java:2234`): recurrent layers implement
+  init_carry(batch, dtype) -> carry pytree
+  apply(..., carry=..., return_carry=True) -> ((y, new_carry), state)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+from .feedforward import BaseOutputLayerConf
+
+__all__ = ["GravesLSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
+           "BaseRecurrentLayer"]
+
+
+@dataclass
+class BaseRecurrentLayer(LayerConf):
+    input_kind = "rnn"
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    @property
+    def is_recurrent(self) -> bool:
+        return True
+
+    def n_in_from(self, it: InputType) -> int:
+        return it.size
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)
+
+
+def _lstm_cell(W, b, peep, n_out, carry, x_t, m_t, forget_gate_offset,
+               gate_act, cell_act):
+    """One Graves-LSTM step. W: [n_in+n_out, 4*n_out] (i, f, o, g blocks),
+    peep: [3*n_out] (input/forget/output peepholes on c)."""
+    h_prev, c_prev = carry
+    zcat = jnp.concatenate([x_t, h_prev], axis=-1)
+    gates = zcat @ W + b  # [B, 4*n_out]
+    i_g, f_g, o_g, g_g = jnp.split(gates, 4, axis=-1)
+    p_i, p_f, p_o = jnp.split(peep, 3)
+    i = gate_act(i_g + c_prev * p_i)
+    f = gate_act(f_g + c_prev * p_f + forget_gate_offset)
+    g = cell_act(g_g)
+    c = f * c_prev + i * g
+    o = gate_act(o_g + c * p_o)
+    h = o * cell_act(c)
+    if m_t is not None:
+        m = m_t[:, None]
+        h = m * h
+        c = m * c + (1.0 - m) * c_prev
+        h_carry = m * h + (1.0 - m) * h_prev
+    else:
+        h_carry = h
+    return (h_carry, c), h
+
+
+@register_layer
+@dataclass
+class GravesLSTM(BaseRecurrentLayer):
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        from .. import activations  # noqa: F401  (resolve at init to fail fast)
+        n_in = self.n_in or it.size
+        n_out = self.n_out
+        k1, k2, k3 = jax.random.split(rng, 3)
+        # input + recurrent weights in one block for a single fused matmul
+        w_in = self._winit(k1, (n_in, 4 * n_out), fan_in=n_in, fan_out=n_out)
+        w_rec = self._winit(k2, (n_out, 4 * n_out), fan_in=n_out, fan_out=n_out)
+        W = jnp.concatenate([w_in, w_rec], axis=0)
+        b = jnp.zeros((4 * n_out,), W.dtype)
+        peep = 0.1 * jax.random.normal(k3, (3 * n_out,), W.dtype)
+        return {"W": W, "b": b, "peep": peep}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),
+                jnp.zeros((batch, self.n_out), dtype))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              carry=None, return_carry=False):
+        from .. import activations
+        x = self.maybe_dropout_input(x, train, rng)
+        gate_act = activations.get(self.gate_activation)
+        cell_act = activations.get(self.activation or "tanh")
+        batch = x.shape[0]
+        if carry is None:
+            carry = self.init_carry(batch, x.dtype)
+        else:
+            carry = jax.tree_util.tree_map(lambda a: a.astype(x.dtype), carry)
+        # forget bias offset kept out of `b` so zero-init b + offset matches
+        # the reference's forgetGateBiasInit semantics
+        offs = self.forget_gate_bias_init
+
+        def step(c, inp):
+            x_t, m_t = inp
+            return _lstm_cell(params["W"], params["b"], params["peep"],
+                              self.n_out, c, x_t, m_t, offs, gate_act, cell_act)
+
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+        ms = None if mask is None else jnp.swapaxes(
+            mask.astype(x.dtype), 0, 1)
+        if ms is None:
+            final, hs = lax.scan(lambda c, x_t: step(c, (x_t, None)), carry, xs)
+        else:
+            final, hs = lax.scan(step, carry, (xs, ms))
+        y = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+        if return_carry:
+            return (y, final), state
+        return y, state
+
+
+@register_layer
+@dataclass
+class GravesBidirectionalLSTM(BaseRecurrentLayer):
+    """Forward + backward GravesLSTM, outputs concatenated ([B,T,2*n_out])."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(2 * self.n_out, it.timesteps)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def _dir_layer(self):
+        return GravesLSTM(n_in=self.n_in, n_out=self.n_out,
+                          activation=self.activation,
+                          gate_activation=self.gate_activation,
+                          forget_gate_bias_init=self.forget_gate_bias_init,
+                          weight_init=self.weight_init, dist=self.dist,
+                          dtype=self.dtype)
+
+    def init_params(self, rng, it: InputType):
+        k1, k2 = jax.random.split(rng)
+        sub = self._dir_layer()
+        return {"fwd": sub.init_params(k1, it), "bwd": sub.init_params(k2, it)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        sub = self._dir_layer()
+        y_f, _ = sub.apply(params["fwd"], {}, x, train=train, rng=rng, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_b, _ = sub.apply(params["bwd"], {}, x_rev, train=train, rng=rng,
+                           mask=m_rev)
+        y_b = jnp.flip(y_b, axis=1)
+        return jnp.concatenate([y_f, y_b], axis=-1), state
+
+
+@register_layer
+@dataclass
+class RnnOutputLayer(BaseOutputLayerConf):
+    """Time-distributed output + loss: logits [B, T, C]; per-timestep mask
+    weighting in the loss (reference RnnOutputLayer + masked scoring)."""
+
+    input_kind = "rnn"
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "softmax"
+
+    def n_in_from(self, it: InputType) -> int:
+        return it.size
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def init_params(self, rng, it: InputType):
+        n_in = self.n_in or it.size
+        p = {"W": self._winit(rng, (n_in, self.n_out),
+                              fan_in=n_in, fan_out=self.n_out)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,))
+        return p
+
+    def preout(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        z = x @ params["W"]  # [B, T, C]
+        if self.has_bias:
+            z = z + params["b"]
+        return z

@@ -57,6 +57,8 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._rng = None
         self._input_types = None  # input type *to* each layer (post-preprocessor)
+        self._rnn_carries = None
+        self._pretrained = False
 
     # ------------------------------------------------------------------
     # Initialization
@@ -99,20 +101,31 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Pure functional core (closed over static layer configs)
     # ------------------------------------------------------------------
-    def _forward(self, params, state, x, train, rng, fmask=None, upto=None):
-        """Returns (activations_of_last_requested_layer, new_state, mask)."""
+    def _forward(self, params, state, x, train, rng, fmask=None, upto=None,
+                 carries=None):
+        """Returns (activations, new_state, mask, new_carries).
+
+        `carries` (tuple, entry per layer, None for non-recurrent layers)
+        threads RNN hidden state across TBPTT chunks / rnn_time_step calls."""
         n = len(self.layers) if upto is None else upto
         rngs = _split_or_none(rng, max(1, n))
         new_state = list(state)
+        new_carries = list(carries) if carries is not None else [None] * len(self.layers)
         mask = fmask
         for i in range(n):
             layer = self.layers[i]
             if i in self.conf.preprocessors:
                 x = self.conf.preprocessors[i].apply(x)
                 mask = self.conf.preprocessors[i].apply_mask(mask)
-            x, new_state[i] = layer.apply(params[i], state[i], x,
-                                          train=train, rng=rngs[i], mask=mask)
-        return x, tuple(new_state), mask
+            if carries is not None and getattr(layer, "is_recurrent", False):
+                (x, new_carries[i]), new_state[i] = layer.apply(
+                    params[i], state[i], x, train=train, rng=rngs[i],
+                    mask=mask, carry=carries[i], return_carry=True)
+            else:
+                x, new_state[i] = layer.apply(params[i], state[i], x,
+                                              train=train, rng=rngs[i],
+                                              mask=mask)
+        return x, tuple(new_state), mask, tuple(new_carries)
 
     def _reg_score(self, params):
         reg = jnp.float32(0.0)
@@ -122,7 +135,7 @@ class MultiLayerNetwork:
         return reg
 
     def _loss_fn(self, params, state, x, y, rng, fmask=None, lmask=None,
-                 train=True):
+                 train=True, carries=None):
         """Scalar score = mean per-example loss + regularization/batch
         (reference `BaseOutputLayer.computeScore` semantics)."""
         out_layer = self.layers[-1]
@@ -133,8 +146,9 @@ class MultiLayerNetwork:
             rng, out_rng = jax.random.split(rng)
         else:
             out_rng = None
-        h, new_state, mask = self._forward(params, state, x, train, rng,
-                                           fmask=fmask, upto=n - 1)
+        h, new_state, mask, new_carries = self._forward(
+            params, state, x, train, rng, fmask=fmask, upto=n - 1,
+            carries=carries)
         if (n - 1) in self.conf.preprocessors:
             h = self.conf.preprocessors[n - 1].apply(h)
             mask = self.conf.preprocessors[n - 1].apply_mask(mask)
@@ -144,7 +158,7 @@ class MultiLayerNetwork:
                                     train=train, rng=out_rng, mask=eff_lmask)
         batch = x.shape[0]
         score = loss + self._reg_score(params) / batch
-        return score, new_state
+        return score, (new_state, new_carries)
 
     def _layer_lr(self, layer: LayerConf, step):
         """Scheduled, per-layer learning rate (None = updater default)."""
@@ -158,10 +172,12 @@ class MultiLayerNetwork:
         return lr
 
     def _make_train_step(self):
-        def train_step(params, state, opt_state, step, x, y, rng, fmask, lmask):
-            (score, new_state), grads = jax.value_and_grad(
+        def train_step(params, state, opt_state, step, x, y, rng, fmask,
+                       lmask, carries=None):
+            (score, (new_state, new_carries)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params, state, x, y, rng,
-                                             fmask=fmask, lmask=lmask)
+                                             fmask=fmask, lmask=lmask,
+                                             carries=carries)
             if not self.conf.conf.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             new_params, new_opt = [], []
@@ -191,7 +207,14 @@ class MultiLayerNetwork:
                                for k, v in updates.items()}
                 new_params.append({k: p[k] - updates[k] for k in p})
                 new_opt.append(os)
-            return tuple(new_params), new_state, tuple(new_opt), score
+            if carries is None:
+                return tuple(new_params), new_state, tuple(new_opt), score
+            # TBPTT chunk step: carries cross chunk boundaries as *inputs*, so
+            # gradients naturally stop at the boundary (the reference's
+            # rnnActivateUsingStoredState + truncated backprop,
+            # MultiLayerNetwork.java:1119)
+            return (tuple(new_params), new_state, tuple(new_opt), score,
+                    new_carries)
 
         return train_step
 
@@ -208,9 +231,25 @@ class MultiLayerNetwork:
     @functools.cached_property
     def _predict_fn(self):
         def predict(params, state, x, fmask):
-            out, _, _ = self._forward(params, state, x, False, None, fmask=fmask)
+            out, _, _, _ = self._forward(params, state, x, False, None,
+                                         fmask=fmask)
             return out
         return jax.jit(predict)
+
+    @functools.cached_property
+    def _tbptt_step(self):
+        return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _rnn_step_fn(self):
+        """One-step stateful inference (reference rnnTimeStep,
+        MultiLayerNetwork.java:2234): x is [B, 1, F] (or [B, F] upgraded),
+        carries in/out."""
+        def step(params, state, x, carries):
+            out, _, _, new_carries = self._forward(params, state, x, False,
+                                                   None, carries=carries)
+            return out, new_carries
+        return jax.jit(step)
 
     @functools.cached_property
     def _score_fn(self):
@@ -234,6 +273,11 @@ class MultiLayerNetwork:
             return self
         if not isinstance(data, DataSetIterator):
             raise TypeError(f"Cannot fit on {type(data)}")
+        if self.conf.pretrain and not self._pretrained:
+            self.pretrain(data)
+            self._pretrained = True
+        if not self.conf.backprop:
+            return self
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -248,11 +292,15 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, ds: DataSet):
-        self._rng, step_rng = jax.random.split(self._rng)
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)
         fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and x.ndim == 3):
+            self._fit_tbptt(x, y, fmask, lmask)
+            return
+        self._rng, step_rng = jax.random.split(self._rng)
         step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
         self.params, self.state, self.updater_state, score = self._train_step(
             self.params, self.state, self.updater_state, step, x, y,
@@ -262,6 +310,123 @@ class MultiLayerNetwork:
         self.iteration_count += 1
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
+
+    def _zero_carries(self, batch: int, dtype=jnp.float32):
+        return tuple(
+            layer.init_carry(batch, dtype)
+            if getattr(layer, "is_recurrent", False) else None
+            for layer in self.layers)
+
+    def _fit_tbptt(self, x, y, fmask, lmask):
+        """Truncated BPTT (reference `doTruncatedBPTT`,
+        `MultiLayerNetwork.java:1119`): split the series into fwd-length
+        chunks; hidden state flows forward between chunks, gradients do not."""
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = self._zero_carries(int(x.shape[0]), x.dtype)
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            self._rng, step_rng = jax.random.split(self._rng)
+            step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
+            (self.params, self.state, self.updater_state, score,
+             carries) = self._tbptt_step(
+                self.params, self.state, self.updater_state, step,
+                x[:, sl], y[:, sl], step_rng,
+                None if fmask is None else fmask[:, sl],
+                None if lmask is None else lmask[:, sl], carries)
+            self._score = score
+            self.last_batch_size = int(x.shape[0])
+            self.iteration_count += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+
+    # ------------------------------------------------------------------
+    # Layerwise pretraining (reference `pretrain`, MultiLayerNetwork.java:161)
+    # ------------------------------------------------------------------
+    def pretrain(self, iterator: DataSetIterator, epochs: int = 1):
+        """Greedy layerwise unsupervised pretraining of AE/RBM/VAE layers."""
+        if self.params is None:
+            self.init()
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "is_pretrainable", False):
+                self.pretrain_layer(i, iterator, epochs)
+        return self
+
+    def pretrain_layer(self, i: int, iterator: DataSetIterator,
+                       epochs: int = 1):
+        layer = self.layers[i]
+        if not getattr(layer, "is_pretrainable", False):
+            return self
+        if self.params is None:
+            self.init()
+        step_fn = self._make_pretrain_step(i)
+        opt_i = self.updater_state[i]
+        it_count = 0
+        for _ in range(epochs):
+            iterator.reset()
+            while iterator.has_next():
+                ds = iterator.next()
+                self._rng, rng = jax.random.split(self._rng)
+                new_pi, opt_i, score = step_fn(
+                    self.params, self.state, opt_i,
+                    jnp.asarray(it_count, jnp.int32),
+                    jnp.asarray(ds.features), rng)
+                params = list(self.params)
+                params[i] = new_pi
+                self.params = tuple(params)
+                self._score = score
+                it_count += 1
+        opt = list(self.updater_state)
+        opt[i] = opt_i
+        self.updater_state = tuple(opt)
+        return self
+
+    def _make_pretrain_step(self, i: int):
+        layer = self.layers[i]
+        upd = self._layer_updater(layer)
+
+        def pstep(params, state, opt_i, step, x, rng):
+            rng_fwd, rng_p = jax.random.split(rng)
+            h = x
+            if i > 0:
+                h, _, _, _ = self._forward(params, state, h, False, None,
+                                           upto=i)
+            # preprocessor feeding layer i (not applied by _forward(upto=i))
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i].apply(h)
+            score, grads = layer.pretrain_value_and_grad(params[i], h, rng_p)
+            grads = apply_gradient_normalization(
+                layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0, grads)
+            lr = self._layer_lr(layer, step)
+            updates, opt_i = upd.update(grads, opt_i, step, lr)
+            new_pi = {k: params[i][k] - updates[k] for k in params[i]}
+            return new_pi, opt_i, score
+
+        return jax.jit(pstep)
+
+    # ------------------------------------------------------------------
+    # Stateful RNN inference (reference rnnTimeStep / rnnClearPreviousState)
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, x) -> jax.Array:
+        """Feed one (or a few) timesteps, carrying hidden state across calls.
+        x: [B, F] (single step) or [B, T, F]."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if getattr(self, "_rnn_carries", None) is None:
+            self._rnn_carries = self._zero_carries(int(x.shape[0]), x.dtype)
+        out, self._rnn_carries = self._rnn_step_fn(self.params, self.state, x,
+                                                   self._rnn_carries)
+        return out[:, 0] if (squeeze and out.ndim == 3) else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        c = getattr(self, "_rnn_carries", None)
+        return None if c is None else c[layer_idx]
 
     # ------------------------------------------------------------------
     # Inference / scoring

@@ -1,0 +1,128 @@
+"""Recurrent layer tests: LSTM/BiLSTM gradient checks, masking, TBPTT,
+stateful rnn_time_step (reference: GravesLSTMTest, GradientCheckTestsMasking,
+MultiLayerTest TBPTT suites)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, GradientCheckUtil,
+                                GravesBidirectionalLSTM, GravesLSTM,
+                                InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, RnnOutputLayer, Sgd,
+                                Adam, ArrayDataSetIterator)
+from deeplearning4j_tpu.nn.conf import BackpropType
+from deeplearning4j_tpu.models.zoo import char_rnn
+
+
+def _rnn_net(*layers, n_in=4, T=6, seed=12345):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list()
+    for l in layers:
+        b.layer(l)
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(n_in, T)).build()).init()
+
+
+def _seq_data(n=4, T=6, n_in=4, n_out=3, seed=0, mask=False):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, T, n_in))
+    idx = r.integers(0, n_out, (n, T))
+    y = np.zeros((n, T, n_out));
+    for i in range(n):
+        y[i, np.arange(T), idx[i]] = 1.0
+    lm = None
+    if mask:
+        lengths = r.integers(2, T + 1, n)
+        lm = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float64)
+    return DataSet(x, y, features_mask=lm, labels_mask=lm)
+
+
+def test_lstm_gradients():
+    net = _rnn_net(GravesLSTM(n_out=5, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+    assert GradientCheckUtil.check_gradients(net, _seq_data())
+
+
+def test_bilstm_gradients():
+    net = _rnn_net(GravesBidirectionalLSTM(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+    assert GradientCheckUtil.check_gradients(net, _seq_data())
+
+
+def test_masked_gradients():
+    net = _rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+    assert GradientCheckUtil.check_gradients(net, _seq_data(mask=True))
+
+
+def test_mask_equivalence_padding_irrelevant():
+    """Padded-and-masked series must score identically to the unpadded series
+    (the reference's masking contract)."""
+    net = _rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                   T=None)
+    r = np.random.default_rng(1)
+    x_short = r.normal(size=(2, 3, 4))
+    y_short = np.zeros((2, 3, 3)); y_short[:, :, 0] = 1.0
+    pad_x = np.concatenate([x_short, r.normal(size=(2, 2, 4)) * 100], axis=1)
+    pad_y = np.concatenate([y_short, np.zeros((2, 2, 3))], axis=1)
+    pad_y[:, 3:, 1] = 1.0  # garbage labels on padded steps
+    m = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 0, 0]], np.float64)
+    s_short = net.score(DataSet(x_short, y_short))
+    s_pad = net.score(DataSet(pad_x, pad_y, features_mask=m, labels_mask=m))
+    np.testing.assert_allclose(s_short, s_pad, rtol=1e-6)
+
+
+def test_rnn_time_step_matches_full_forward():
+    net = _rnn_net(GravesLSTM(n_out=5, activation="tanh"),
+                   GravesLSTM(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                   T=None)
+    r = np.random.default_rng(2)
+    x = r.normal(size=(2, 7, 4))
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    step_outs = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(7)]
+    np.testing.assert_allclose(np.stack(step_outs, axis=1), full, rtol=1e-5)
+    # clearing state restarts
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(again, step_outs[0], rtol=1e-6)
+
+
+def test_tbptt_fits_and_counts_chunks():
+    model = char_rnn(vocab_size=8, lstm_size=8, seq_len=12, tbptt=4)
+    model.init()
+    r = np.random.default_rng(0)
+    idx = r.integers(0, 8, (4, 12))
+    x = np.eye(8, dtype=np.float32)[idx]
+    y = np.eye(8, dtype=np.float32)[np.roll(idx, -1, 1)]
+    model.fit(DataSet(x, y))
+    # 12 steps / tbptt 4 = 3 chunk iterations
+    assert model.iteration_count == 3
+    assert np.isfinite(model.score())
+
+
+def test_char_rnn_learns_identity_sequence():
+    """Deterministic next-token task: next char == current char."""
+    vocab, T = 6, 10
+    model = char_rnn(vocab_size=vocab, lstm_size=32, seq_len=T, tbptt=10)
+    model.conf.backprop_type = BackpropType.STANDARD
+    model.init()
+    r = np.random.default_rng(3)
+    idx = r.integers(0, vocab, (64, T))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = x.copy()  # predict the same char
+    model.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=60)
+    out = np.asarray(model.output(x[:8]))
+    acc = (out.argmax(-1) == idx[:8]).mean()
+    assert acc > 0.95, acc
+
+
+def test_lstm_evaluation_time_series():
+    net = _rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+    ds = _seq_data(mask=True)
+    ev = net.evaluate(ArrayDataSetIterator(
+        ds.features, ds.labels, batch_size=2,
+        features_mask=ds.features_mask, labels_mask=ds.labels_mask))
+    assert 0.0 <= ev.accuracy() <= 1.0
+    assert ev.num_examples() == int(ds.labels_mask.sum())
