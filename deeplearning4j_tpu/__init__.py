@@ -32,8 +32,18 @@ from .nn.layers import (ActivationLayer, AutoEncoder, BatchNormalization,
 from .nn.updaters import (AdaDelta, AdaGrad, Adam, AdaMax, Nesterovs, NoOp,
                           RmsProp, Sgd)
 from .nn.weights import Distribution, WeightInit
-from .datasets import ArrayDataSetIterator, DataSet, DataSetIterator
-from .eval import Evaluation
+from .nn.graph import ComputationGraph
+from .nn.conf.graph import (ComputationGraphConfiguration,
+                            DuplicateToTimeSeriesVertex, ElementWiseVertex,
+                            GraphVertex, L2NormalizeVertex, L2Vertex,
+                            LastTimeStepVertex, MergeVertex,
+                            PreprocessorVertex, ScaleVertex, ShiftVertex,
+                            StackVertex, SubsetVertex, UnstackVertex)
+from .nn.transferlearning import (FineTuneConfiguration, TransferLearning,
+                                  TransferLearningHelper)
+from .datasets import (ArrayDataSetIterator, DataSet, DataSetIterator,
+                       MultiDataSet)
+from .eval import (Evaluation, ROC, ROCMultiClass, RegressionEvaluation)
 from .util import GradientCheckUtil, ModelSerializer
 
 __all__ = [
@@ -52,6 +62,13 @@ __all__ = [
     "ZeroPaddingLayer",
     "AdaDelta", "AdaGrad", "Adam", "AdaMax", "Nesterovs", "NoOp", "RmsProp",
     "Sgd", "Distribution", "WeightInit",
-    "ArrayDataSetIterator", "DataSet", "DataSetIterator", "Evaluation",
+    "ComputationGraph", "ComputationGraphConfiguration",
+    "DuplicateToTimeSeriesVertex", "ElementWiseVertex", "GraphVertex",
+    "L2NormalizeVertex", "L2Vertex", "LastTimeStepVertex", "MergeVertex",
+    "PreprocessorVertex", "ScaleVertex", "ShiftVertex", "StackVertex",
+    "SubsetVertex", "UnstackVertex",
+    "FineTuneConfiguration", "TransferLearning", "TransferLearningHelper",
+    "ArrayDataSetIterator", "DataSet", "DataSetIterator", "MultiDataSet",
+    "Evaluation", "ROC", "ROCMultiClass", "RegressionEvaluation",
     "GradientCheckUtil", "ModelSerializer",
 ]

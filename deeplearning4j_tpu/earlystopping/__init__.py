@@ -1,0 +1,21 @@
+from .earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+    EarlyStoppingGraphTrainer, DataSetLossCalculator, InMemoryModelSaver,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    BestScoreEpochTerminationCondition, MaxScoreIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult",
+    "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+    "DataSetLossCalculator", "InMemoryModelSaver", "LocalFileModelSaver",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+]

@@ -1,3 +1,6 @@
 from .evaluation import Evaluation, ConfusionMatrix
+from .roc import ROC, ROCMultiClass
+from .regression import RegressionEvaluation
 
-__all__ = ["Evaluation", "ConfusionMatrix"]
+__all__ = ["Evaluation", "ConfusionMatrix", "ROC", "ROCMultiClass",
+           "RegressionEvaluation"]

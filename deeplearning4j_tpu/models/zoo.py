@@ -17,7 +17,7 @@ from ..nn.multilayer import MultiLayerNetwork
 from ..nn.updaters import Adam, Nesterovs
 
 __all__ = ["lenet_mnist", "bench_lenet", "mlp_mnist", "char_rnn",
-           "bench_char_rnn"]
+           "bench_char_rnn", "resnet50", "bench_resnet50", "vgg16"]
 
 
 def lenet_mnist(seed: int = 42, updater=None) -> MultiLayerNetwork:
@@ -99,6 +99,124 @@ def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 20,
     jax.block_until_ready(model.params)
     dt = time.perf_counter() - t0
     return batch * seq_len * steps / dt, "charRNN-tokens"
+
+
+def resnet50(n_classes: int = 1000, image: int = 224, seed: int = 42,
+             updater=None, blocks=(3, 4, 6, 3), width: int = 64):
+    """ResNet-50 as a ComputationGraph (BASELINE config #2): bottleneck
+    residual blocks via ElementWiseVertex(add) — the reference expresses
+    ResNet the same way with its vertex API. NHWC, bottleneck 1-3-1 convs,
+    BN+ReLU."""
+    from ..nn.conf import InputType
+    from ..nn.conf.graph import ElementWiseVertex
+    from ..nn.graph import ComputationGraph
+    from ..nn.layers import (ActivationLayer, BatchNormalization,
+                             GlobalPoolingLayer)
+
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or Adam(1e-3))
+         .weight_init("relu")
+         .graph_builder()
+         .add_inputs("input")
+         .set_input_types(InputType.convolutional(image, image, 3)))
+
+    def conv_bn_relu(name, inp, n_out, k, s, relu=True):
+        b.add_layer(f"{name}_conv",
+                    ConvolutionLayer(n_out=n_out, kernel_size=(k, k),
+                                     stride=(s, s), activation="identity",
+                                     convolution_mode=ConvolutionMode.SAME,
+                                     has_bias=False), inp)
+        b.add_layer(f"{name}_bn",
+                    BatchNormalization(activation="relu" if relu else "identity"),
+                    f"{name}_conv")
+        return f"{name}_bn"
+
+    top = conv_bn_relu("stem", "input", width, 7, 2)
+    b.add_layer("stem_pool",
+                SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                 kernel_size=(3, 3), stride=(2, 2),
+                                 convolution_mode=ConvolutionMode.SAME),
+                top)
+    top = "stem_pool"
+
+    ch = width
+    for stage, n_blocks in enumerate(blocks):
+        out_ch = ch * 4
+        for blk in range(n_blocks):
+            name = f"s{stage}b{blk}"
+            stride = 2 if (blk == 0 and stage > 0) else 1
+            t1 = conv_bn_relu(f"{name}_1", top, ch, 1, stride)
+            t2 = conv_bn_relu(f"{name}_2", t1, ch, 3, 1)
+            t3 = conv_bn_relu(f"{name}_3", t2, out_ch, 1, 1, relu=False)
+            if blk == 0:
+                sc = conv_bn_relu(f"{name}_sc", top, out_ch, 1, stride,
+                                  relu=False)
+            else:
+                sc = top
+            b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), t3, sc)
+            b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                        f"{name}_add")
+            top = f"{name}_relu"
+        ch *= 2
+
+    b.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                top)
+    b.add_layer("fc", OutputLayer(n_out=n_classes, activation="softmax",
+                                  loss="mcxent"), "avgpool")
+    b.set_outputs("fc")
+    return ComputationGraph(b.build())
+
+
+def bench_resnet50(batch: int = 64, steps: int = 10, warmup: int = 2,
+                   image: int = 224, n_classes: int = 1000):
+    """samples/sec for ResNet-50 ImageNet-shaped training (BASELINE #2)."""
+    import jax
+
+    from ..datasets.iterators import DataSet
+
+    model = resnet50(image=image, n_classes=n_classes).init()
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, image, image, 3)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[r.integers(0, n_classes, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "ResNet50-ImageNet"
+
+
+def vgg16(n_classes: int = 1000, image: int = 224, seed: int = 42,
+          updater=None) -> MultiLayerNetwork:
+    """VGG-16 (BASELINE config #5 uses this for multi-host data parallel).
+    Mirrors the reference's TrainedModels.VGG16 topology."""
+    from ..nn.conf import InputType
+
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or Nesterovs(learning_rate=0.01, momentum=0.9))
+         .weight_init("relu")
+         .list())
+    for v in cfg:
+        if v == "M":
+            b.layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                     kernel_size=(2, 2), stride=(2, 2)))
+        else:
+            b.layer(ConvolutionLayer(n_out=v, kernel_size=(3, 3),
+                                     stride=(1, 1), activation="relu",
+                                     convolution_mode=ConvolutionMode.SAME))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+    conf = b.set_input_type(InputType.convolutional(image, image, 3)).build()
+    return MultiLayerNetwork(conf)
 
 
 def bench_lenet(batch: int = 512, steps: int = 40, warmup: int = 5):

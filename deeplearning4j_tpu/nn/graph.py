@@ -1,0 +1,373 @@
+"""ComputationGraph — the DAG model.
+
+Capability parity with `nn/graph/ComputationGraph.java:79` (2447 LoC):
+multiple inputs/outputs, vertex system, topological execution, fit on
+DataSet/MultiDataSet, evaluate, rnn state. TPU-first design mirrors
+MultiLayerNetwork: params/state are dicts keyed by vertex name, the whole DAG
+(all vertices in topo order) traces into ONE jitted train step, backward via
+`jax.grad` of the summed output losses.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf import NeuralNetConfiguration
+from .conf.base import LayerConf
+from .conf.graph import ComputationGraphConfiguration, GraphVertex
+from .gradnorm import apply_gradient_normalization
+from .layers.feedforward import BaseOutputLayerConf
+from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
+from ..eval.evaluation import Evaluation
+
+__all__ = ["ComputationGraph"]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.listeners = []
+        self.last_batch_size = 0
+        self.params: Optional[Dict[str, Dict]] = None
+        self.state: Optional[Dict[str, Dict]] = None
+        self.updater_state: Optional[Dict[str, Any]] = None
+        self._score = float("nan")
+        self._rng = None
+
+    # ------------------------------------------------------------------
+    @property
+    def layer_vertices(self) -> Dict[str, LayerConf]:
+        return {k: v for k, v in self.conf.vertices.items()
+                if isinstance(v, LayerConf)}
+
+    def get_layer(self, name: str) -> LayerConf:
+        return self.conf.vertices[name]
+
+    @property
+    def topological_order(self) -> List[str]:
+        return self.conf.topological_order
+
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        seed = self.conf.conf.seed if seed is None else seed
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        names = sorted(self.layer_vertices)
+        rngs = dict(zip(names, jax.random.split(init_rng, max(1, len(names)))))
+        params, state = {}, {}
+        for name, layer in self.layer_vertices.items():
+            it = self._input_type_for(name)
+            params[name] = layer.init_params(rngs[name], it)
+            state[name] = layer.init_state(it)
+        self.params = params
+        self.state = state
+        self.updater_state = {
+            name: self._layer_updater(self.conf.vertices[name]).init(p)
+            for name, p in params.items()}
+        return self
+
+    def _input_type_for(self, name):
+        rec = self.conf.inferred_input_types.get(name)
+        if rec is not None:
+            it = rec[1]
+            if isinstance(it, list):
+                it = it[0]
+            return it
+        from .conf.input_type import InputType
+        layer = self.conf.vertices[name]
+        n_in = getattr(layer, "n_in", None)
+        if layer.has_params and not n_in:
+            raise ValueError(
+                f"Vertex '{name}' needs n_in or graph input_types")
+        return InputType.feed_forward(n_in or 0)
+
+    def _layer_updater(self, layer):
+        return (layer.updater if isinstance(layer, LayerConf) and layer.updater
+                else self.conf.conf.updater)
+
+    # ------------------------------------------------------------------
+    # Functional core
+    # ------------------------------------------------------------------
+    def _forward_values(self, params, state, inputs: Dict[str, Any], train,
+                        rng, fmasks: Optional[Dict[str, Any]] = None,
+                        stop_at_outputs: bool = False):
+        """Execute vertices in topo order. Returns (values, masks, new_state).
+        Output-layer vertices contribute their *pre-activation input* (the
+        caller applies loss or activation)."""
+        values: Dict[str, Any] = dict(inputs)
+        masks: Dict[str, Any] = dict(fmasks or {})
+        for k in self.conf.network_inputs:
+            masks.setdefault(k, None)
+        new_state = dict(state)
+        layer_names = [n for n in self.conf.topological_order
+                       if n in self.conf.vertices]
+        rngs = (jax.random.split(rng, max(1, len(layer_names)))
+                if rng is not None else [None] * len(layer_names))
+        out_set = set(self.conf.network_outputs) if stop_at_outputs else set()
+        for i, name in enumerate(layer_names):
+            v = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            ins = [values[i_] for i_ in in_names]
+            in_masks = [masks.get(i_) for i_ in in_names]
+            if isinstance(v, LayerConf):
+                x = ins[0]
+                m = in_masks[0]
+                rec = self.conf.inferred_input_types.get(name)
+                if rec is not None and rec[0] is not None:
+                    x = rec[0].apply(x)
+                    m = rec[0].apply_mask(m)
+                if name in out_set and isinstance(v, BaseOutputLayerConf):
+                    values[name] = (x, m)  # defer loss/activation to caller
+                    masks[name] = m
+                    continue
+                y, new_state[name] = v.apply(params[name], state[name], x,
+                                             train=train, rng=rngs[i], mask=m)
+                values[name] = y
+                masks[name] = m
+            else:
+                values[name] = v.apply(ins, in_masks)
+                masks[name] = v.output_mask(in_masks)
+        return values, masks, new_state
+
+    def _loss_fn(self, params, state, inputs, labels, rng, fmasks=None,
+                 lmasks=None, train=True):
+        """labels: dict {output_name: labels}; lmasks likewise."""
+        values, masks, new_state = self._forward_values(
+            params, state, inputs, train, rng, fmasks, stop_at_outputs=True)
+        total = jnp.float32(0.0)
+        batch = next(iter(inputs.values())).shape[0]
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if not isinstance(v, BaseOutputLayerConf):
+                raise ValueError(
+                    f"Network output '{name}' must be an output/loss layer "
+                    "for training")
+            x, m = values[name]
+            lm = (lmasks or {}).get(name)
+            eff = lm if lm is not None else m
+            total = total + v.loss_score(params[name], state[name], x,
+                                         labels[name], train=train, rng=None,
+                                         mask=eff)
+        reg = jnp.float32(0.0)
+        for name, p in params.items():
+            if p:
+                reg = reg + self.conf.vertices[name].reg_score(p)
+        return total + reg / batch, new_state
+
+    def _make_train_step(self):
+        def train_step(params, state, opt_state, step, inputs, labels, rng,
+                       fmasks, lmasks):
+            (score, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, state, inputs, labels,
+                                             rng, fmasks=fmasks,
+                                             lmasks=lmasks)
+            if not self.conf.conf.minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            new_params, new_opt = {}, {}
+            for name, p in params.items():
+                layer = self.conf.vertices[name]
+                g, os = grads[name], opt_state[name]
+                if not p or layer.frozen:
+                    new_params[name] = p
+                    new_opt[name] = os
+                    continue
+                g = apply_gradient_normalization(
+                    layer.gradient_normalization,
+                    layer.gradient_normalization_threshold or 1.0, g)
+                upd = self._layer_updater(layer)
+                lr = self._layer_lr(layer, step)
+                updates, os = upd.update(g, os, step, lr)
+                new_params[name] = {k: p[k] - updates[k] for k in p}
+                new_opt[name] = os
+            return new_params, new_state, new_opt, score
+
+        return train_step
+
+    def _layer_lr(self, layer, step):
+        sched = self.conf.conf.lr_schedule
+        base = layer.learning_rate
+        if sched is None:
+            return base
+        lr = sched(step)
+        if base is not None and sched.base_lr:
+            lr = lr * (base / sched.base_lr)
+        return lr
+
+    @functools.cached_property
+    def train_step_fn(self):
+        return self._make_train_step()
+
+    @functools.cached_property
+    def _train_step(self):
+        return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _predict_fn(self):
+        def predict(params, state, inputs, fmasks):
+            values, masks, _ = self._forward_values(
+                params, state, inputs, False, None, fmasks,
+                stop_at_outputs=True)
+            outs = []
+            for name in self.conf.network_outputs:
+                v = self.conf.vertices[name]
+                if isinstance(v, BaseOutputLayerConf):
+                    x, m = values[name]
+                    y, _ = v.apply(params[name], state[name], x, train=False,
+                                   rng=None, mask=m)
+                else:
+                    y = values[name]
+                outs.append(y)
+            return tuple(outs)
+        return jax.jit(predict)
+
+    @functools.cached_property
+    def _score_fn(self):
+        def score(params, state, inputs, labels, fmasks, lmasks):
+            s, _ = self._loss_fn(params, state, inputs, labels, None,
+                                 fmasks=fmasks, lmasks=lmasks, train=False)
+            return s
+        return jax.jit(score)
+
+    # ------------------------------------------------------------------
+    # Data plumbing
+    # ------------------------------------------------------------------
+    def _to_inputs(self, ds) -> Tuple[Dict, Dict, Dict, Dict]:
+        ins = self.conf.network_inputs
+        outs = self.conf.network_outputs
+        if isinstance(ds, DataSet):
+            if len(ins) != 1 or len(outs) != 1:
+                raise ValueError("DataSet fits single-input/single-output "
+                                 "graphs; use MultiDataSet")
+            inputs = {ins[0]: jnp.asarray(ds.features)}
+            labels = {outs[0]: jnp.asarray(ds.labels)}
+            fmasks = {ins[0]: None if ds.features_mask is None
+                      else jnp.asarray(ds.features_mask)}
+            lmasks = {outs[0]: None if ds.labels_mask is None
+                      else jnp.asarray(ds.labels_mask)}
+            return inputs, labels, fmasks, lmasks
+        if isinstance(ds, MultiDataSet):
+            inputs = {n: jnp.asarray(f) for n, f in zip(ins, ds.features)}
+            labels = {n: jnp.asarray(l) for n, l in zip(outs, ds.labels)}
+            fm = ds.features_masks or [None] * len(ins)
+            lm = ds.labels_masks or [None] * len(outs)
+            fmasks = {n: (None if m is None else jnp.asarray(m))
+                      for n, m in zip(ins, fm)}
+            lmasks = {n: (None if m is None else jnp.asarray(m))
+                      for n, m in zip(outs, lm)}
+            return inputs, labels, fmasks, lmasks
+        raise TypeError(f"Cannot fit on {type(ds)}")
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+    def fit(self, data, epochs: int = 1):
+        if self.params is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            self._fit_batch(data)
+            return self
+        for _ in range(epochs):
+            data.reset()
+            while data.has_next():
+                self._fit_batch(data.next())
+            self.epoch_count += 1
+        return self
+
+    def _fit_batch(self, ds):
+        inputs, labels, fmasks, lmasks = self._to_inputs(ds)
+        self._rng, step_rng = jax.random.split(self._rng)
+        step = jnp.asarray(self.iteration_count, jnp.int32)
+        (self.params, self.state, self.updater_state,
+         score) = self._train_step(self.params, self.state,
+                                   self.updater_state, step, inputs, labels,
+                                   step_rng, fmasks, lmasks)
+        self._score = score
+        self.last_batch_size = int(next(iter(inputs.values())).shape[0])
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+
+    def output(self, *features, features_masks=None):
+        if self.params is None:
+            self.init()
+        ins = self.conf.network_inputs
+        inputs = {n: jnp.asarray(f) for n, f in zip(ins, features)}
+        fmasks = {n: None for n in ins}
+        if features_masks is not None:
+            fmasks = {n: (None if m is None else jnp.asarray(m))
+                      for n, m in zip(ins, features_masks)}
+        return self._predict_fn(self.params, self.state, inputs, fmasks)
+
+    def output_single(self, *features, **kw):
+        return self.output(*features, **kw)[0]
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return float(self._score)
+        inputs, labels, fmasks, lmasks = self._to_inputs(ds)
+        return float(self._score_fn(self.params, self.state, inputs, labels,
+                                    fmasks, lmasks))
+
+    def evaluate(self, iterator, labels_list=None, top_n: int = 1) -> Evaluation:
+        ev = Evaluation(labels=labels_list, top_n=top_n)
+        iterator.reset()
+        while iterator.has_next():
+            ds = iterator.next()
+            if isinstance(ds, DataSet):
+                out = self.output(ds.features,
+                                  features_masks=[ds.features_mask])[0]
+                ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+            else:
+                outs = self.output(*ds.features,
+                                   features_masks=ds.features_masks)
+                for o, l, m in zip(outs, ds.labels,
+                                   ds.labels_masks or [None] * len(ds.labels)):
+                    ev.eval(l, np.asarray(o), mask=m)
+        return ev
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(self.params))
+
+    def params_flat(self) -> np.ndarray:
+        parts = []
+        for name in sorted(self.params):
+            p = self.params[name]
+            for k in sorted(p):
+                parts.append(np.asarray(p[k]).ravel())
+        return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+    def set_params_flat(self, vec: np.ndarray):
+        vec = np.asarray(vec)
+        pos = 0
+        new_params = {}
+        for name in sorted(self.params):
+            p = self.params[name]
+            d = {}
+            for k in sorted(p):
+                n = int(np.prod(p[k].shape))
+                d[k] = jnp.asarray(vec[pos:pos + n].reshape(p[k].shape),
+                                   dtype=p[k].dtype)
+                pos += n
+            new_params[name] = d
+        self.params = new_params
+
+    def clone(self) -> "ComputationGraph":
+        g = ComputationGraph(self.conf)
+        if self.params is not None:
+            copy = lambda a: jnp.array(a, copy=True)
+            g.params = jax.tree_util.tree_map(copy, self.params)
+            g.state = jax.tree_util.tree_map(copy, self.state)
+            g.updater_state = jax.tree_util.tree_map(copy, self.updater_state)
+            g._rng = self._rng
+        g.iteration_count = self.iteration_count
+        return g

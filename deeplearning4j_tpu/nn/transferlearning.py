@@ -1,0 +1,251 @@
+"""Transfer learning.
+
+Parity with `nn/transferlearning/TransferLearning.java:34` (.Builder and
+.GraphBuilder), `FineTuneConfiguration.java`, and `TransferLearningHelper.java`:
+clone a trained net, freeze layers up to a boundary, remove/replace output
+layers, override training hyperparameters, and featurize through the frozen
+part. Frozen layers = `frozen=True` on the layer config — the jitted train
+step skips their updates (optimizer masking), which is the TPU-native form of
+the reference's FrozenLayer wrapper; XLA's DCE then prunes their backward
+computation entirely.
+"""
+from __future__ import annotations
+
+import copy as _copy
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+import jax
+
+from .conf import MultiLayerConfiguration, NeuralNetConfiguration
+from .conf.base import LayerConf
+from .multilayer import MultiLayerNetwork
+from ..datasets.iterators import DataSet
+
+__all__ = ["FineTuneConfiguration", "TransferLearning",
+           "TransferLearningHelper"]
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to all non-frozen layers
+    (reference `FineTuneConfiguration.java`)."""
+
+    updater: Optional[object] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+    weight_init: Optional[str] = None
+    activation: Optional[str] = None
+
+    class Builder:
+        def __init__(self):
+            self._c = FineTuneConfiguration()
+
+        def updater(self, u, learning_rate=None):
+            from . import updaters as _updaters
+            self._c.updater = _updaters.get(u, learning_rate); return self
+
+        def l1(self, v):
+            self._c.l1 = float(v); return self
+
+        def l2(self, v):
+            self._c.l2 = float(v); return self
+
+        def dropout(self, v):
+            self._c.dropout = float(v); return self
+
+        def seed(self, s):
+            self._c.seed = int(s); return self
+
+        def weight_init(self, w):
+            self._c.weight_init = w; return self
+
+        def activation(self, a):
+            self._c.activation = a; return self
+
+        def build(self):
+            return self._c
+
+    def apply_to_global(self, conf: NeuralNetConfiguration) -> NeuralNetConfiguration:
+        kw = {}
+        if self.updater is not None:
+            kw["updater"] = self.updater
+        if self.l1 is not None:
+            kw["l1"] = self.l1
+            kw["use_regularization"] = True
+        if self.l2 is not None:
+            kw["l2"] = self.l2
+            kw["use_regularization"] = True
+        if self.seed is not None:
+            kw["seed"] = self.seed
+        return replace(conf, **kw) if kw else conf
+
+    def apply_to_layer(self, layer: LayerConf) -> LayerConf:
+        kw = {}
+        if self.updater is not None:
+            kw["updater"] = self.updater
+        if self.l1 is not None:
+            kw["l1"] = self.l1
+        if self.l2 is not None:
+            kw["l2"] = self.l2
+        if self.dropout is not None:
+            kw["dropout"] = self.dropout
+        return replace(layer, **kw) if kw else layer
+
+
+class TransferLearning:
+    """`TransferLearning.Builder(model)` fluent API."""
+
+    class Builder:
+        def __init__(self, model: MultiLayerNetwork):
+            if model.params is None:
+                raise ValueError("Model must be initialized/trained first")
+            self._model = model
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._n_out_replacements: Dict[int, tuple] = {}
+            self._remove_from: Optional[int] = None
+            self._appended: List[LayerConf] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference setFeatureExtractor)."""
+            self._freeze_until = int(layer_idx)
+            return self
+
+        def nout_replace(self, layer_idx: int, n_out: int,
+                         weight_init: Optional[str] = None):
+            """Change a layer's n_out and reinit it (+ reinit next layer's
+            n_in) — reference nOutReplace."""
+            self._n_out_replacements[int(layer_idx)] = (int(n_out), weight_init)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(len(self._model.layers) - 1)
+
+        def remove_layers_from_output(self, idx: int):
+            self._remove_from = int(idx)
+            return self
+
+        def add_layer(self, layer: LayerConf):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._model
+            layers = [replace(l) for l in src.layers]
+            params = [dict(p) for p in src.params]
+            reinit = set()
+
+            if self._remove_from is not None:
+                layers = layers[:self._remove_from]
+                params = params[:self._remove_from]
+
+            for idx, (n_out, w_init) in sorted(self._n_out_replacements.items()):
+                if idx >= len(layers):
+                    raise ValueError(f"nout_replace index {idx} out of range")
+                kw = {"n_out": n_out}
+                if w_init:
+                    kw["weight_init"] = w_init
+                layers[idx] = replace(layers[idx], **kw)
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1] = replace(layers[idx + 1], n_in=None)
+                    reinit.add(idx + 1)
+
+            n_existing = len(layers)
+            layers.extend(self._appended)
+            params.extend({} for _ in self._appended)
+            reinit.update(range(n_existing, len(layers)))
+
+            if self._fine_tune is not None:
+                layers = [l if l.frozen else self._fine_tune.apply_to_layer(l)
+                          for l in layers]
+
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    layers[i] = replace(layers[i], frozen=True)
+
+            g_conf = src.conf.conf
+            if self._fine_tune is not None:
+                g_conf = self._fine_tune.apply_to_global(g_conf)
+
+            # re-run shape inference over the edited layer list
+            from .conf import ListBuilder
+            lb = ListBuilder(g_conf)
+            for l in layers:
+                lb.layer(l)
+            if src.conf.input_type is not None:
+                lb.set_input_type(src.conf.input_type)
+            for i, pp in src.conf.preprocessors.items():
+                if i < len(layers):
+                    lb.input_pre_processor(i, pp)
+            new_conf = lb.build()
+            # ListBuilder re-resolves inheritance; keep frozen flags
+            new_net = MultiLayerNetwork(new_conf)
+            new_net.init()
+            # copy kept params; reinit'ed layers keep fresh values
+            new_params = list(new_net.params)
+            for i in range(len(new_conf.layers)):
+                if i < len(params) and i not in reinit and params[i]:
+                    new_params[i] = jax.tree_util.tree_map(
+                        lambda a: jax.numpy.array(a, copy=True), params[i])
+            new_net.params = tuple(new_params)
+            return new_net
+
+
+class TransferLearningHelper:
+    """Featurize through the frozen part once; train only the unfrozen tail
+    (reference `TransferLearningHelper.java`)."""
+
+    def __init__(self, model: MultiLayerNetwork,
+                 frozen_until: Optional[int] = None):
+        self.model = model
+        if frozen_until is None:
+            frozen_until = -1
+            for i, l in enumerate(model.layers):
+                if l.frozen:
+                    frozen_until = i
+        self.frozen_until = frozen_until
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Run the frozen head once and return a DataSet of features for the
+        trainable tail."""
+        import jax.numpy as jnp
+        import numpy as np
+        x = jnp.asarray(ds.features)
+        h, _, _, _ = self.model._forward(self.model.params, self.model.state,
+                                         x, False, None,
+                                         upto=self.frozen_until + 1)
+        return DataSet(np.asarray(h), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def unfrozen_graph(self) -> MultiLayerNetwork:
+        """A network of only the unfrozen tail (shares param values)."""
+        from .conf import ListBuilder
+        tail_layers = self.model.layers[self.frozen_until + 1:]
+        lb = ListBuilder(self.model.conf.conf)
+        for l in tail_layers:
+            lb.layer(replace(l))
+        net = MultiLayerNetwork(lb.build())
+        net.init()
+        net.params = tuple(
+            jax.tree_util.tree_map(lambda a: jax.numpy.array(a, copy=True), p)
+            for p in self.model.params[self.frozen_until + 1:])
+        return net
+
+    def fit_featurized(self, ds: DataSet):
+        """Train the tail on featurized data, writing params back."""
+        tail = self.unfrozen_graph()
+        tail.fit(ds)
+        k = self.frozen_until + 1
+        new_params = list(self.model.params)
+        for i, p in enumerate(tail.params):
+            new_params[k + i] = p
+        self.model.params = tuple(new_params)
+        return self.model

@@ -1,0 +1,221 @@
+"""ComputationGraph tests (reference: ComputationGraphConfigurationTest,
+TestComputationGraphNetwork, GradientCheckTestsComputationGraph)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, ComputationGraph,
+                                ComputationGraphConfiguration, DataSet,
+                                DenseLayer, DuplicateToTimeSeriesVertex,
+                                ElementWiseVertex, GravesLSTM, InputType,
+                                L2NormalizeVertex, L2Vertex,
+                                LastTimeStepVertex, MergeVertex, MultiDataSet,
+                                NeuralNetConfiguration, OutputLayer,
+                                RnnOutputLayer, ScaleVertex, Sgd, StackVertex,
+                                SubsetVertex, UnstackVertex, ModelSerializer)
+from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+
+
+def _simple_graph(seed=0):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(10))
+            .build())
+
+
+def test_topo_order_and_shape_inference():
+    conf = _simple_graph()
+    assert conf.topological_order[0] == "in"
+    assert conf.vertices["dense"].n_in == 10
+    assert conf.vertices["out"].n_in == 16
+
+
+def test_graph_json_roundtrip():
+    conf = _simple_graph()
+    js = conf.to_json()
+    back = ComputationGraphConfiguration.from_json(js)
+    assert back.to_json() == js
+
+
+def test_graph_trains_like_mln(classification_data):
+    xs, ys = classification_data
+    g = ComputationGraph(_simple_graph()).init()
+    it = ArrayDataSetIterator(xs, ys, batch_size=32, shuffle=True, seed=1)
+    g.fit(it, epochs=20)
+    ev = g.evaluate(ArrayDataSetIterator(xs, ys, batch_size=64))
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_merge_and_elementwise_vertices():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=8, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=8, activation="tanh"), "b")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "da", "db")
+            .add_vertex("scaled", ScaleVertex(scale=0.5), "sum")
+            .add_vertex("merged2", MergeVertex(), "merge", "scaled")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "merged2")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4), InputType.feed_forward(6))
+            .build())
+    assert conf.vertices["out"].n_in == 24  # 16 merge + 8 scaled
+    g = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    mds = MultiDataSet(
+        features=[r.normal(size=(5, 4)), r.normal(size=(5, 6))],
+        labels=[np.eye(2)[r.integers(0, 2, 5)]])
+    g.fit(mds)
+    out = g.output(mds.features[0], mds.features[1])
+    assert out[0].shape == (5, 2)
+
+
+def test_subset_stack_unstack_l2():
+    import jax.numpy as jnp
+    sv = SubsetVertex(from_idx=1, to_idx=3)
+    x = jnp.arange(10.0).reshape(2, 5)
+    np.testing.assert_allclose(np.asarray(sv.apply([x])),
+                               [[1, 2, 3], [6, 7, 8]])
+    st = StackVertex()
+    assert st.apply([x, x]).shape == (4, 5)
+    un = UnstackVertex(from_idx=1, stack_size=2)
+    np.testing.assert_allclose(np.asarray(un.apply([st.apply([x, x])])),
+                               np.asarray(x))
+    l2 = L2Vertex()
+    d = l2.apply([x, x + 1.0])
+    np.testing.assert_allclose(np.asarray(d), np.sqrt(5.0) * np.ones((2, 1)),
+                               rtol=1e-4)
+    l2n = L2NormalizeVertex()
+    out = np.asarray(l2n.apply([x + 1.0]))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+def test_multi_output_graph():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("shared", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out1", OutputLayer(n_out=2, loss="mcxent"), "shared")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "shared")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(5))
+            .build())
+    g = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    mds = MultiDataSet(features=[r.normal(size=(6, 5))],
+                       labels=[np.eye(2)[r.integers(0, 2, 6)],
+                               r.normal(size=(6, 1))])
+    s0 = g.score(mds)
+    for _ in range(20):
+        g.fit(mds)
+    assert g.score(mds) < s0
+    o1, o2 = g.output(mds.features[0])
+    assert o1.shape == (6, 2) and o2.shape == (6, 1)
+
+
+def test_rnn_vertices_last_timestep_and_duplicate():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("seq", "static")
+            .add_layer("lstm", GravesLSTM(n_out=6, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(), "lstm")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(), "static", "lstm")
+            .add_vertex("merged", MergeVertex(), "lstm", "dup")
+            .add_layer("rnnout", RnnOutputLayer(n_out=2, loss="mcxent"),
+                       "merged")
+            .set_outputs("rnnout")
+            .set_input_types(InputType.recurrent(4, 5),
+                             InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    seq = r.normal(size=(2, 5, 4))
+    stat = r.normal(size=(2, 3))
+    idx = r.integers(0, 2, (2, 5))
+    y = np.eye(2)[idx]
+    mds = MultiDataSet(features=[seq, stat], labels=[y])
+    g.fit(mds)
+    assert np.isfinite(g.score())
+
+
+def test_graph_gradients():
+    """GradientCheckTestsComputationGraph pattern on a merge+elementwise DAG."""
+    conf = (NeuralNetConfiguration.builder().seed(12345).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=4, activation="tanh"), "b")
+            .add_vertex("add", ElementWiseVertex(op="add"), "da", "db")
+            .add_vertex("merge", MergeVertex(), "da", "add")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    inputs = {"a": np.asarray(r.normal(size=(5, 3))),
+              "b": np.asarray(r.normal(size=(5, 3)))}
+    y = {"out": np.eye(2)[r.integers(0, 2, 5)]}
+
+    import jax.numpy as jnp
+    inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+    y = {k: jnp.asarray(v) for k, v in y.items()}
+
+    def loss(params):
+        s, _ = g._loss_fn(params, g.state, inputs, y, None)
+        return s
+
+    ok, fails = check_gradients_fn(loss, g.params)
+    assert ok, fails[:5]
+
+
+def test_graph_checkpoint_roundtrip(tmp_path, classification_data):
+    xs, ys = classification_data
+    g = ComputationGraph(_simple_graph()).init()
+    g.fit(DataSet(xs[:64], ys[:64]))
+    path = str(tmp_path / "graph.zip")
+    ModelSerializer.write_model(g, path)
+    g2 = ModelSerializer.restore(path)
+    assert isinstance(g2, ComputationGraph)
+    np.testing.assert_allclose(np.asarray(g2.output(xs[:8])[0]),
+                               np.asarray(g.output(xs[:8])[0]), rtol=1e-6)
+
+
+def test_resnet50_builds_and_runs_tiny():
+    """ResNet-50 topology compiles and steps on tiny shapes."""
+    from deeplearning4j_tpu.models.zoo import resnet50
+    g = resnet50(n_classes=5, image=32, blocks=(1, 1, 1, 1), width=8).init()
+    r = np.random.default_rng(0)
+    x = r.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[r.integers(0, 5, 2)]
+    g.fit(DataSet(x, y))
+    assert np.isfinite(g.score())
+    out = g.output(x)[0]
+    assert out.shape == (2, 5)
+
+
+def test_cycle_detection():
+    b = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=4), "b")
+         .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+         .set_outputs("b"))
+    with pytest.raises(ValueError):
+        b.build()
+
+
+def test_bad_input_reference():
+    b = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=4), "nonexistent")
+         .set_outputs("a"))
+    with pytest.raises(ValueError):
+        b.build()

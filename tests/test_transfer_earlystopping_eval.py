@@ -1,0 +1,226 @@
+"""Transfer learning, early stopping, ROC/regression eval tests (reference:
+TransferLearning*Test, TestEarlyStopping, ROCTest, RegressionEvalTest)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, DataSet,
+                                DenseLayer, FineTuneConfiguration, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, ROC, ROCMultiClass,
+                                RegressionEvaluation, Sgd, TransferLearning,
+                                TransferLearningHelper)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+
+from conftest import make_classification
+
+
+def _base_model(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# --------------------------- transfer learning -----------------------------
+
+def test_transfer_freeze_and_replace_head(classification_data):
+    xs, ys = classification_data
+    src = _base_model()
+    src.fit(ArrayDataSetIterator(xs, ys, batch_size=64), epochs=3)
+    frozen_w = np.asarray(src.params[0]["W"]).copy()
+
+    new = (TransferLearning.Builder(src)
+           .fine_tune_configuration(
+               FineTuneConfiguration.Builder().updater(Sgd(0.05)).build())
+           .set_feature_extractor(1)        # freeze layers 0..1
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, loss="mcxent"))
+           .build())
+    assert new.layers[0].frozen and new.layers[1].frozen
+    assert not new.layers[2].frozen
+    assert new.layers[2].n_out == 5
+    assert new.layers[2].n_in == 8
+    # frozen weights copied from source
+    np.testing.assert_allclose(np.asarray(new.params[0]["W"]), frozen_w)
+
+    y5 = np.zeros((len(xs), 5))
+    y5[np.arange(len(xs)), np.random.default_rng(0).integers(0, 5, len(xs))] = 1
+    new.fit(DataSet(xs[:64], y5[:64]))
+    # frozen unchanged after training
+    np.testing.assert_allclose(np.asarray(new.params[0]["W"]), frozen_w)
+
+
+def test_transfer_nout_replace(classification_data):
+    src = _base_model()
+    new = (TransferLearning.Builder(src)
+           .nout_replace(1, 12)
+           .build())
+    assert new.layers[1].n_out == 12
+    assert new.layers[2].n_in == 12
+    # layer 0 params preserved
+    np.testing.assert_allclose(np.asarray(new.params[0]["W"]),
+                               np.asarray(src.params[0]["W"]))
+
+
+def test_transfer_helper_featurize(classification_data):
+    xs, ys = classification_data
+    src = _base_model()
+    new = (TransferLearning.Builder(src).set_feature_extractor(0).build())
+    helper = TransferLearningHelper(new)
+    assert helper.frozen_until == 0
+    feat = helper.featurize(DataSet(xs[:32], ys[:32]))
+    assert feat.features.shape == (32, 16)
+    before = np.asarray(new.params[1]["W"]).copy()
+    helper.fit_featurized(feat)
+    assert not np.allclose(np.asarray(new.params[1]["W"]), before)
+    # frozen layer untouched
+    np.testing.assert_allclose(np.asarray(new.params[0]["W"]),
+                               np.asarray(src.params[0]["W"]))
+
+
+# --------------------------- early stopping --------------------------------
+
+def test_early_stopping_max_epochs(tmp_path, classification_data):
+    xs, ys = classification_data
+    model = _base_model()
+    train = ArrayDataSetIterator(xs[:192], ys[:192], batch_size=64)
+    val = ArrayDataSetIterator(xs[192:], ys[192:], batch_size=64)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(val))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(cfg, model, train).fit()
+    assert result.total_epochs == 5
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 5
+    # best model scores <= last epoch score
+    assert result.best_model_score <= list(result.score_vs_epoch.values())[-1] + 1e-9
+
+
+def test_early_stopping_score_improvement(classification_data):
+    xs, ys = classification_data
+    model = _base_model()
+    train = ArrayDataSetIterator(xs, ys, batch_size=64)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(xs, ys, batch_size=64)))
+           .epoch_termination_conditions(
+               ScoreImprovementEpochTerminationCondition(3, 1e-3),
+               MaxEpochsTerminationCondition(200))
+           .build())
+    result = EarlyStoppingTrainer(cfg, model, train).fit()
+    assert result.total_epochs < 200
+    assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+
+def test_early_stopping_local_file_saver(tmp_path, classification_data):
+    xs, ys = classification_data
+    model = _base_model()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(xs, ys, batch_size=128)))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+           .model_saver(LocalFileModelSaver(str(tmp_path)))
+           .build())
+    result = EarlyStoppingTrainer(
+        cfg, model, ArrayDataSetIterator(xs, ys, batch_size=64)).fit()
+    assert (tmp_path / "bestModel.zip").exists()
+    best = result.best_model
+    assert best.score(DataSet(xs[:32], ys[:32])) == pytest.approx(
+        model.score(DataSet(xs[:32], ys[:32])), rel=1e-4)
+
+
+def test_early_stopping_iteration_condition(classification_data):
+    xs, ys = classification_data
+    model = _base_model()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(xs, ys, batch_size=128)))
+           .iteration_termination_conditions(
+               MaxScoreIterationTerminationCondition(1e-12))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+           .build())
+    result = EarlyStoppingTrainer(
+        cfg, model, ArrayDataSetIterator(xs, ys, batch_size=64)).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+# --------------------------- ROC / regression ------------------------------
+
+def test_roc_perfect_classifier():
+    roc = ROC(threshold_steps=50)
+    labels = np.array([0, 0, 1, 1, 0, 1] * 10)
+    probs = labels * 0.8 + 0.1  # perfectly separated
+    roc.eval(labels, probs)
+    assert roc.calculate_auc() > 0.99
+
+
+def test_roc_random_classifier():
+    rng = np.random.default_rng(0)
+    roc = ROC(threshold_steps=100)
+    labels = rng.integers(0, 2, 5000)
+    probs = rng.random(5000)
+    roc.eval(labels, probs)
+    assert abs(roc.calculate_auc() - 0.5) < 0.05
+
+
+def test_roc_onehot_and_curve():
+    roc = ROC()
+    labels = np.eye(2)[[0, 1, 1, 0]]
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+    roc.eval(labels, probs)
+    assert roc.calculate_auc() == pytest.approx(1.0)
+    curve = roc.get_roc_curve()
+    assert len(curve) == 101
+    assert roc.calculate_auprc() > 0.85
+
+
+def test_roc_multiclass():
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, 3, 600)
+    labels = np.eye(3)[idx]
+    logits = labels * 3.0 + rng.normal(0, 1.0, (600, 3))
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    rmc = ROCMultiClass()
+    rmc.eval(labels, probs)
+    for c in range(3):
+        assert rmc.calculate_auc(c) > 0.85
+    assert rmc.calculate_average_auc() > 0.85
+
+
+def test_regression_evaluation():
+    rng = np.random.default_rng(0)
+    labels = rng.normal(size=(200, 2))
+    preds = labels + rng.normal(0, 0.1, (200, 2))
+    re = RegressionEvaluation(column_names=["a", "b"])
+    # accumulate in two batches
+    re.eval(labels[:100], preds[:100])
+    re.eval(labels[100:], preds[100:])
+    for c in range(2):
+        assert re.mean_squared_error(c) == pytest.approx(
+            float(np.mean((preds[:, c] - labels[:, c]) ** 2)), rel=1e-6)
+        assert re.pearson_correlation(c) > 0.99
+        assert re.root_mean_squared_error(c) == pytest.approx(
+            np.sqrt(re.mean_squared_error(c)))
+    assert "a" in re.stats()
+    assert re.average_pearson_correlation() > 0.99
+
+
+def test_regression_evaluation_masked_timeseries():
+    labels = np.ones((2, 3, 1))
+    preds = np.zeros((2, 3, 1))
+    mask = np.array([[1, 1, 0], [1, 0, 0]], np.float64)
+    re = RegressionEvaluation(n_columns=1)
+    re.eval_time_series(labels, preds, labels_mask=mask)
+    assert re.count[0] == 3
+    assert re.mean_squared_error(0) == pytest.approx(1.0)
