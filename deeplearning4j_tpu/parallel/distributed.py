@@ -1,0 +1,74 @@
+"""Multi-host distributed runtime.
+
+The TPU-native replacement for the reference's cluster plumbing: where DL4J
+bootstraps Spark executors + broadcast (`SparkDl4jMultiLayer`) or an Aeron
+media driver (`ParameterServerParallelWrapper.java:159-165`), a JAX TPU pod
+needs only `jax.distributed.initialize` — the ICI/DCN fabric and the XLA
+runtime replace the parameter plane entirely; the host-side gRPC coordinator
+is only used for process rendezvous and the dataset plane.
+
+Degrades gracefully to single-process (the CI/local case): `initialize()` is
+a no-op when no coordinator is configured.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+
+from .mesh import MeshAxes, make_hybrid_mesh, make_mesh
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["initialize", "is_multi_host", "global_mesh", "process_index",
+           "local_batch_slice"]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Initialize multi-host JAX. No-op when single-process (no coordinator
+    configured via args or JAX_COORDINATOR_ADDRESS env)."""
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if addr is None:
+        log.debug("distributed.initialize: single-process mode")
+        return False
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_mesh(model_parallel: int = 1, seq_parallel: int = 1,
+                pipe_parallel: int = 1, data_parallel: Optional[int] = None):
+    """Standard mesh factory: model/seq/pipe axes innermost (ICI), data axis
+    outermost (spans DCN on multi-slice). Single-slice falls back to a flat
+    mesh."""
+    n = len(jax.devices())
+    inner = model_parallel * seq_parallel * pipe_parallel
+    if n % inner:
+        raise ValueError(f"{n} devices not divisible by inner {inner}")
+    dp = data_parallel if data_parallel is not None else n // inner
+    axes = {MeshAxes.DATA: dp, MeshAxes.PIPE: pipe_parallel,
+            MeshAxes.SEQ: seq_parallel, MeshAxes.MODEL: model_parallel}
+    axes = {k: v for k, v in axes.items() if v > 1 or k == MeshAxes.DATA}
+    return make_mesh(axes)
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This process's slice of a globally-sharded batch (dataset plane: each
+    host feeds only its own shard — the reference's Spark exporters did the
+    analogous split with `balancedRandomSplit`)."""
+    per = global_batch // jax.process_count()
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)

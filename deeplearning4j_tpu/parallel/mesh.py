@@ -1,0 +1,79 @@
+"""Device mesh construction.
+
+The TPU-native replacement for the reference's device plumbing
+(`ParallelWrapper`'s AffinityManager thread pinning, Spark executor topology):
+a named `jax.sharding.Mesh` over which pjit/shard_map place computation and
+XLA inserts ICI/DCN collectives.
+
+Axes convention used throughout this package:
+  * "data"  — data parallelism (batch sharding; gradient allreduce)
+  * "model" — tensor parallelism (param sharding inside layers)
+  * "pipe"  — pipeline stages
+  * "seq"   — sequence/context parallelism (ring attention)
+
+Multi-host: `make_hybrid_mesh` puts the replica axis on DCN and keeps
+model/seq axes inside the ICI slice (the scaling-book recipe).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "make_hybrid_mesh", "replicated", "data_sharding",
+           "MeshAxes"]
+
+
+class MeshAxes:
+    DATA = "data"
+    MODEL = "model"
+    PIPE = "pipe"
+    SEQ = "seq"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from {axis_name: size}. Sizes must multiply to the device
+    count; a single {"data": -1} (or None) means 'all devices, data axis'."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {MeshAxes.DATA: n}
+    axes = dict(axes)
+    wild = [k for k, v in axes.items() if v in (-1, None)]
+    if len(wild) > 1:
+        raise ValueError("At most one axis size may be -1")
+    fixed = int(np.prod([v for v in axes.values() if v not in (-1, None)]))
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        axes[wild[0]] = n // fixed
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"Mesh {axes} needs {total} devices, have {n}")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, axis_names=tuple(axes.keys()))
+
+
+def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]) -> Mesh:
+    """Multi-slice mesh: `dcn_axes` across slices (data-parallel replicas over
+    DCN), `ici_axes` within a slice (model/seq axes ride ICI). Uses
+    `mesh_utils.create_hybrid_device_mesh`."""
+    from jax.experimental import mesh_utils
+
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    mesh_shape = tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values())
+    devs = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape, dcn_shape, devices=jax.devices())
+    return Mesh(devs, axis_names=names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_sharding(mesh: Mesh, axis: str = MeshAxes.DATA) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))

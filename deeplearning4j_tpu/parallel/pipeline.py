@@ -1,0 +1,128 @@
+"""Pipeline parallelism over a mesh axis.
+
+NEW capability relative to the reference (SURVEY.md §2.4: pipeline parallelism
+absent). GPipe-style SPMD pipeline in the idiomatic JAX form: stage params are
+stacked on a leading axis sharded over "pipe"; microbatch activations tick
+through the ring with `jax.lax.ppermute` inside `shard_map`. The whole
+schedule (bubble included) is one differentiable traced program, so the
+backward pipeline comes from `jax.grad` — no hand-written 1F1B scheduler.
+
+Restriction (standard for SPMD pipelining): pipelined stages must share one
+program = identical layer structure and [.., F] -> [.., F] activation shape.
+Heterogeneous head/tail layers (embedding, classifier) run replicated outside
+the pipe region — compose with `PipelinedMLP` below.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_forward", "PipelinedDenseStack"]
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x_microbatches,
+                     axis_name: str, n_stages: int):
+    """Run inside shard_map. Each device holds stacked_params' local block
+    (its stage's params, leading axis 1) and the full microbatch stream.
+
+    stage_fn(params, x) -> y, with y.shape == x.shape.
+    x_microbatches: [M, mb, F] (replicated). Returns [M, mb, F]: microbatch
+    outputs after all stages (valid on the LAST stage; other stages carry
+    in-flight values).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    n_ticks = M + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    mb_shape = x_microbatches.shape[1:]
+    buf = jnp.zeros((M,) + mb_shape, x_microbatches.dtype)
+    carry_in = jnp.zeros(mb_shape, x_microbatches.dtype)
+
+    def tick(t, state):
+        carry_in, buf = state
+        # stage 0 injects microbatch t (if any); others take the permuted input
+        inject = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, M - 1), keepdims=False)
+        x_in = jnp.where(stage == 0, inject, carry_in)
+        y = stage_fn(jax.tree_util.tree_map(lambda a: a[0], stacked_params),
+                     x_in)
+        # last stage writes its finished microbatch t - (n_stages-1)
+        out_idx = t - (n_stages - 1)
+        write = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        buf = jax.lax.cond(
+            write,
+            lambda b: jax.lax.dynamic_update_index_in_dim(
+                b, y, jnp.clip(out_idx, 0, M - 1), axis=0),
+            lambda b: b, buf)
+        carry_next = jax.lax.ppermute(y, axis_name, perm)
+        return carry_next, buf
+
+    _, buf = jax.lax.fori_loop(0, n_ticks, tick, (carry_in, buf))
+    # only the last stage holds finished outputs; psum makes the result
+    # genuinely replicated across the pipe axis
+    buf = jnp.where(stage == n_stages - 1, buf, jnp.zeros_like(buf))
+    return jax.lax.psum(buf, axis_name)
+
+
+class PipelinedDenseStack:
+    """S identical Dense(F->F, activation) stages pipelined over `axis`.
+    The minimal concrete pipeline model used for equivalence tests and as the
+    template for pipelining homogeneous blocks of a larger net."""
+
+    def __init__(self, features: int, n_stages: int, mesh: Mesh,
+                 axis: str = "pipe", activation: str = "tanh", seed: int = 0):
+        from ..nn import activations as _act
+
+        self.features = features
+        self.n_stages = n_stages
+        self.mesh = mesh
+        self.axis = axis
+        self._act = _act.get(activation)
+        k = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+        scale = 1.0 / np.sqrt(features)
+        self.params = {
+            "W": jnp.stack([jax.random.normal(k[i], (features, features))
+                            * scale for i in range(n_stages)]),
+            "b": jnp.zeros((n_stages, features)),
+        }
+
+    def _stage_fn(self, p, x):
+        return self._act(x @ p["W"] + p["b"])
+
+    def reference_forward(self, params, x):
+        """Sequential single-device execution (oracle)."""
+        for s in range(self.n_stages):
+            p = jax.tree_util.tree_map(lambda a: a[s], params)
+            x = self._stage_fn(p, x)
+        return x
+
+    def pipelined_forward(self, params, x, n_microbatches: Optional[int] = None):
+        """x: [B, F] -> [B, F] through the pipeline."""
+        from jax import shard_map
+
+        M = n_microbatches or self.n_stages
+        B = x.shape[0]
+        assert B % M == 0, "batch must divide into microbatches"
+        xm = x.reshape(M, B // M, self.features)
+
+        fn = shard_map(
+            functools.partial(pipeline_forward, self._stage_fn,
+                              axis_name=self.axis, n_stages=self.n_stages),
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P()),
+            out_specs=P(),
+            check_vma=False)
+
+        def wrapper(params, xm):
+            return fn(params, xm)
+
+        stage_sh = NamedSharding(self.mesh, P(self.axis))
+        params = jax.device_put(params, stage_sh)
+        out = jax.jit(wrapper)(params, xm)
+        return out.reshape(B, self.features)

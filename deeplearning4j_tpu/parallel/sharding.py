@@ -1,0 +1,117 @@
+"""Parameter sharding rules (tensor parallelism / FSDP).
+
+The reference has NO tensor parallelism (`SURVEY.md` §2.4: model/tensor/
+pipeline parallelism absent) — this is new TPU-native capability. Rules
+produce PartitionSpec pytrees matching a model's params; handed to `jax.jit`
+as in/out shardings, XLA inserts the ICI collectives (all-gather for FSDP
+params, psum for TP partial sums) automatically.
+
+Strategies:
+  * replicated — pure data parallelism (grad allreduce; subsumes
+    ParallelWrapper / ParameterAveragingTrainingMaster sync mode)
+  * tensor_parallel — Megatron-style: 2-D weights sharded on the output
+    feature axis over "model"; biases sharded to match; embedding/LSTM/conv
+    sharded on their output-channel axis
+  * fsdp — every tensor sharded on its largest axis over "data"
+    (ZeRO-3-style param sharding; XLA re-gathers on use)
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MeshAxes
+
+__all__ = ["param_specs", "shard_model", "ShardingStrategy"]
+
+
+class ShardingStrategy:
+    REPLICATED = "replicated"
+    TENSOR_PARALLEL = "tensor_parallel"
+    FSDP = "fsdp"
+
+
+def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh):
+    """Output-feature-axis sharding for a single param tensor."""
+    size = mesh.shape[axis]
+    nd = len(shape)
+    if nd == 0:
+        return P()
+    # shard last axis (output features / channels / gate blocks) if divisible
+    if shape[-1] % size == 0 and shape[-1] >= size:
+        return P(*([None] * (nd - 1) + [axis]))
+    return P()
+
+
+def _fsdp_spec_for(shape, axis: str, mesh: Mesh):
+    size = mesh.shape[axis]
+    if not shape:
+        return P()
+    order = np.argsort(shape)[::-1]
+    for ax in order:
+        if shape[ax] % size == 0 and shape[ax] >= size:
+            spec = [None] * len(shape)
+            spec[ax] = axis
+            return P(*spec)
+    return P()
+
+
+def param_specs(params, strategy: str, mesh: Mesh,
+                model_axis: str = MeshAxes.MODEL,
+                data_axis: str = MeshAxes.DATA):
+    """PartitionSpec pytree matching `params` (a MultiLayerNetwork tuple-of-
+    dicts or ComputationGraph dict-of-dicts)."""
+    if strategy == ShardingStrategy.REPLICATED:
+        return jax.tree_util.tree_map(lambda a: P(), params)
+    if strategy == ShardingStrategy.TENSOR_PARALLEL:
+        def spec(path, leaf):
+            key = str(path[-1].key) if hasattr(path[-1], "key") else ""
+            return _tp_spec_for(key, np.shape(leaf), model_axis, mesh)
+        return jax.tree_util.tree_map_with_path(spec, params)
+    if strategy == ShardingStrategy.FSDP:
+        return jax.tree_util.tree_map(
+            lambda a: _fsdp_spec_for(np.shape(a), data_axis, mesh), params)
+    raise ValueError(f"Unknown sharding strategy '{strategy}'")
+
+
+def shard_model(model, mesh: Mesh, strategy: str = ShardingStrategy.REPLICATED,
+                model_axis: str = MeshAxes.MODEL,
+                data_axis: str = MeshAxes.DATA):
+    """Place a model's params/state/updater state on the mesh according to the
+    strategy. Returns the sharding pytrees used (params_sh, state_sh, opt_sh)."""
+    specs = param_specs(model.params, strategy, mesh, model_axis, data_axis)
+    params_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    repl = NamedSharding(mesh, P())
+    model.params = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s), model.params, params_sh)
+    model.state = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, repl), model.state)
+
+    # updater state mirrors param sharding (per-param moments)
+    opt_sh = _opt_sharding_like(model.updater_state, model.params, params_sh)
+    model.updater_state = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s), model.updater_state, opt_sh)
+    return params_sh, repl, opt_sh
+
+
+def _opt_sharding_like(opt_state, params, params_sh):
+    """Optimizer-state sharding congruent to params: each moment tensor gets
+    its param's sharding (matched by shape); scalars replicated."""
+    flat_params = jax.tree_util.tree_leaves(params)
+    flat_sh = jax.tree_util.tree_leaves(
+        params_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    by_shape = {}
+    for p, s in zip(flat_params, flat_sh):
+        by_shape.setdefault(tuple(np.shape(p)), s)
+    some = flat_sh[0] if flat_sh else None
+    repl = NamedSharding(some.mesh, P()) if some is not None else None
+
+    def pick(leaf):
+        return by_shape.get(tuple(np.shape(leaf)), repl)
+
+    return jax.tree_util.tree_map(pick, opt_state)
