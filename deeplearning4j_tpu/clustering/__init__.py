@@ -1,0 +1,5 @@
+from .kmeans import KMeansClustering
+from .kdtree import KDTree
+from .vptree import VPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree"]

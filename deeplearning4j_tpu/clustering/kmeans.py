@@ -1,0 +1,67 @@
+"""K-means clustering.
+
+Parity with `deeplearning4j-core/.../clustering/kmeans/` (KMeansClustering
+over the generic clustering algorithm SPI). TPU-first: Lloyd iterations as
+dense [N,K] distance matmuls + segment means under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KMeansClustering"]
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 0, distance: str = "euclidean"):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.tol = float(tol)
+        self.seed = seed
+        self.distance = distance
+        self.centers: Optional[np.ndarray] = None
+
+    def _dists(self, x, centers):
+        if self.distance == "cosine":
+            xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+            cn = centers / jnp.maximum(
+                jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
+            return 1.0 - xn @ cn.T
+        sq_x = jnp.sum(x * x, axis=1)[:, None]
+        sq_c = jnp.sum(centers * centers, axis=1)[None, :]
+        return sq_x + sq_c - 2.0 * (x @ centers.T)
+
+    def fit(self, x) -> "KMeansClustering":
+        x = jnp.asarray(x, jnp.float32)
+        n = x.shape[0]
+        rng = np.random.default_rng(self.seed)
+        centers = x[jnp.asarray(rng.choice(n, self.k, replace=False))]
+
+        @jax.jit
+        def step(centers):
+            d = self._dists(x, centers)
+            assign = jnp.argmin(d, axis=1)
+            one_hot = jax.nn.one_hot(assign, self.k, dtype=x.dtype)
+            counts = jnp.maximum(one_hot.sum(axis=0), 1.0)
+            new_centers = (one_hot.T @ x) / counts[:, None]
+            # keep empty clusters where they were
+            empty = one_hot.sum(axis=0) == 0
+            new_centers = jnp.where(empty[:, None], centers, new_centers)
+            shift = jnp.max(jnp.linalg.norm(new_centers - centers, axis=1))
+            return new_centers, assign, shift
+
+        for _ in range(self.max_iterations):
+            centers, assign, shift = step(centers)
+            if float(shift) < self.tol:
+                break
+        self.centers = np.asarray(centers)
+        self.labels_ = np.asarray(assign)
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        d = self._dists(jnp.asarray(x, jnp.float32), jnp.asarray(self.centers))
+        return np.asarray(jnp.argmin(d, axis=1))

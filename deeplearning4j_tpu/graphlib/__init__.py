@@ -1,0 +1,8 @@
+from .graph import Graph, Vertex, Edge
+from .loader import GraphLoader
+from .walkers import RandomWalkIterator, WeightedRandomWalkIterator, NoEdgeHandling
+from .deepwalk import DeepWalk, GraphVectors
+
+__all__ = ["Graph", "Vertex", "Edge", "GraphLoader", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "NoEdgeHandling", "DeepWalk",
+           "GraphVectors"]

@@ -1,0 +1,33 @@
+from .tokenization import (CommonPreprocessor, DefaultTokenizer,
+                           DefaultTokenizerFactory, EndingPreProcessor,
+                           LowCasePreProcessor, NGramTokenizer,
+                           NGramTokenizerFactory, Tokenizer, TokenizerFactory,
+                           STOP_WORDS)
+from .sentence_iterator import (BasicLabelAwareIterator, BasicSentenceIterator,
+                                CollectionLabeledSentenceIterator,
+                                CollectionSentenceIterator,
+                                FileSentenceIterator, LabelAwareIterator,
+                                LabelledDocument, LabelsSource,
+                                LineSentenceIterator, SentenceIterator)
+from .vocab import Huffman, VocabCache, VocabConstructor, VocabWord
+from .embeddings import (InMemoryLookupTable, NegativeSampler,
+                         WordVectorsModel)
+from .word2vec import ParagraphVectors, SequenceVectors, Word2Vec
+from .glove import CoOccurrences, Glove
+from .serializer import WordVectorSerializer
+from .bow import BagOfWordsVectorizer, TfidfVectorizer
+
+__all__ = [
+    "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
+    "EndingPreProcessor", "LowCasePreProcessor", "NGramTokenizer",
+    "NGramTokenizerFactory", "Tokenizer", "TokenizerFactory", "STOP_WORDS",
+    "BasicLabelAwareIterator", "BasicSentenceIterator",
+    "CollectionLabeledSentenceIterator", "CollectionSentenceIterator",
+    "FileSentenceIterator", "LabelAwareIterator", "LabelledDocument",
+    "LabelsSource", "LineSentenceIterator", "SentenceIterator",
+    "Huffman", "VocabCache", "VocabConstructor", "VocabWord",
+    "InMemoryLookupTable", "NegativeSampler", "WordVectorsModel",
+    "ParagraphVectors", "SequenceVectors", "Word2Vec",
+    "CoOccurrences", "Glove", "WordVectorSerializer",
+    "BagOfWordsVectorizer", "TfidfVectorizer",
+]

@@ -1,0 +1,283 @@
+"""Embedding lookup table + batched training kernels.
+
+Parity with `models/embeddings/`:
+  * InMemoryLookupTable (`inmemory/InMemoryLookupTable.java:55`) — syn0
+    (input vectors), syn1 (HS output weights), syn1neg (negative-sampling
+    output weights), unigram^0.75 negative-sampling table
+  * learning algorithms (`learning/impl/elements/SkipGram.java:31`, CBOW) —
+    hierarchical softmax + negative sampling
+  * BasicModelUtils (`reader/impl/BasicModelUtils.java`) — wordsNearest /
+    similarity
+
+TPU-first redesign (SURVEY.md §7.8): the reference trains with lock-free
+Hogwild threads doing per-pair axpy on shared arrays
+(`SequenceVectors.java:289`). Here training is *batched*: dense [B] center /
+context index arrays, negatives sampled on device, loss via fused
+gather->dot->logsigmoid, gradients via `jax.grad` whose gather-backward is a
+scatter-add (`segment_sum` equivalent) — embarrassingly data-parallel across
+chips, deterministic given a seed, and MXU/VPU-friendly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import Huffman, VocabCache
+
+__all__ = ["InMemoryLookupTable", "NegativeSampler", "make_skipgram_step",
+           "make_cbow_step", "WordVectorsModel"]
+
+
+class NegativeSampler:
+    """Unigram^0.75 distribution (the reference's negative-sampling table,
+    InMemoryLookupTable.makeTable) — sampled on device via Gumbel-max over
+    log-probs instead of a 100M-entry table."""
+
+    def __init__(self, counts: np.ndarray, power: float = 0.75):
+        p = np.asarray(counts, np.float64) ** power
+        p = p / p.sum()
+        self.log_probs = jnp.asarray(np.log(np.maximum(p, 1e-30)),
+                                     jnp.float32)
+
+    def sample(self, rng, shape) -> jax.Array:
+        g = jax.random.gumbel(rng, shape + (self.log_probs.shape[0],),
+                              jnp.float32)
+        return jnp.argmax(g + self.log_probs, axis=-1).astype(jnp.int32)
+
+
+class InMemoryLookupTable:
+    def __init__(self, vocab: VocabCache, vector_length: int,
+                 seed: int = 12345, use_hs: bool = False,
+                 negative: int = 5):
+        self.vocab = vocab
+        self.vector_length = int(vector_length)
+        self.use_hs = use_hs
+        self.negative = int(negative)
+        V, D = vocab.num_words(), self.vector_length
+        key = jax.random.PRNGKey(seed)
+        # reference init: U(-0.5/D, 0.5/D) for syn0; zeros for syn1/syn1neg
+        self.syn0 = jax.random.uniform(key, (V, D), jnp.float32,
+                                       -0.5 / D, 0.5 / D)
+        self.syn1 = jnp.zeros((V, D), jnp.float32) if use_hs else None
+        self.syn1neg = (jnp.zeros((V, D), jnp.float32)
+                        if negative > 0 else None)
+        self.sampler = (NegativeSampler(vocab.counts_array())
+                        if negative > 0 else None)
+        if use_hs:
+            h = Huffman(vocab)
+            h.build()
+            codes, points, mask = h.codes_arrays()
+            self.hs_codes = jnp.asarray(codes)
+            self.hs_points = jnp.asarray(points)
+            self.hs_mask = jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def vectors_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def set_vectors_matrix(self, m: np.ndarray):
+        self.syn0 = jnp.asarray(m, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Batched training steps (jitted once per table config)
+# ---------------------------------------------------------------------------
+
+def _ns_loss(syn0, syn1neg, centers, contexts, negatives):
+    vc = syn0[centers]                    # [B, D]
+    up = syn1neg[contexts]                # [B, D]
+    un = syn1neg[negatives]               # [B, K, D]
+    pos = jax.nn.log_sigmoid(jnp.sum(vc * up, axis=-1))
+    neg = jnp.sum(jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", vc, un)),
+                  axis=-1)
+    # SUM over the batch: each pair contributes a full-lr update, matching the
+    # reference's per-pair SGD semantics (batched updates accumulate by
+    # scatter-add instead of racing like Hogwild)
+    return -jnp.sum(pos + neg)
+
+
+def _hs_loss(syn0, syn1, centers, contexts, codes, points, mask):
+    """Predict `contexts` from `centers` via the context's Huffman path."""
+    vc = syn0[centers]                    # [B, D]
+    c = codes[contexts]                   # [B, L]
+    p = points[contexts]                  # [B, L]
+    m = mask[contexts]                    # [B, L]
+    w = syn1[p]                           # [B, L, D]
+    dots = jnp.einsum("bd,bld->bl", vc, w)
+    # label 1 - code (word2vec convention): logsigmoid((1-2c)*dot)
+    lp = jax.nn.log_sigmoid((1.0 - 2.0 * c) * dots) * m
+    return -jnp.sum(lp)
+
+
+def make_skipgram_step(table: InMemoryLookupTable):
+    """Returns jitted step(syn0, syn1, syn1neg, centers, contexts, lr, rng)
+    -> (syn0, syn1, syn1neg, loss). Uses HS and/or NS per table config
+    (reference SkipGram.learnSequence:156 handles both)."""
+    K = table.negative
+    use_hs = table.use_hs
+    sampler = table.sampler
+    codes = table.hs_codes if use_hs else None
+    points = table.hs_points if use_hs else None
+    hmask = table.hs_mask if use_hs else None
+
+    def loss_fn(trainables, centers, contexts, negatives):
+        total = 0.0
+        if K > 0:
+            total = total + _ns_loss(trainables["syn0"],
+                                     trainables["syn1neg"], centers,
+                                     contexts, negatives)
+        if use_hs:
+            total = total + _hs_loss(trainables["syn0"], trainables["syn1"],
+                                     centers, contexts, codes, points, hmask)
+        return total
+
+    @jax.jit
+    def step(syn0, syn1, syn1neg, centers, contexts, lr, rng):
+        trainables = {"syn0": syn0}
+        if K > 0:
+            trainables["syn1neg"] = syn1neg
+            negatives = sampler.sample(rng, centers.shape + (K,))
+        else:
+            negatives = None
+        if use_hs:
+            trainables["syn1"] = syn1
+        loss, grads = jax.value_and_grad(loss_fn)(trainables, centers,
+                                                  contexts, negatives)
+        new0 = syn0 - lr * grads["syn0"]
+        new1 = syn1 - lr * grads["syn1"] if use_hs else syn1
+        new1n = syn1neg - lr * grads["syn1neg"] if K > 0 else syn1neg
+        return new0, new1, new1n, loss / centers.shape[0]
+
+    return step
+
+
+def make_cbow_step(table: InMemoryLookupTable, window: int):
+    """CBOW: mean of context-window vectors predicts the center word.
+    contexts: [B, 2*window] padded with -1."""
+    K = table.negative
+    use_hs = table.use_hs
+    sampler = table.sampler
+    codes = table.hs_codes if use_hs else None
+    points = table.hs_points if use_hs else None
+    hmask = table.hs_mask if use_hs else None
+
+    def mean_ctx(syn0, contexts):
+        m = (contexts >= 0).astype(jnp.float32)
+        safe = jnp.maximum(contexts, 0)
+        vecs = syn0[safe] * m[..., None]
+        return jnp.sum(vecs, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1, keepdims=True), 1.0)
+
+    def loss_fn(trainables, centers, contexts, negatives):
+        h = mean_ctx(trainables["syn0"], contexts)     # [B, D]
+        total = 0.0
+        if K > 0:
+            up = trainables["syn1neg"][centers]
+            un = trainables["syn1neg"][negatives]
+            pos = jax.nn.log_sigmoid(jnp.sum(h * up, axis=-1))
+            neg = jnp.sum(jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", h, un)), axis=-1)
+            total = total - jnp.sum(pos + neg)
+        if use_hs:
+            c = codes[centers]
+            p = points[centers]
+            m = hmask[centers]
+            w = trainables["syn1"][p]
+            dots = jnp.einsum("bd,bld->bl", h, w)
+            lp = jax.nn.log_sigmoid((1.0 - 2.0 * c) * dots) * m
+            total = total - jnp.sum(lp)
+        return total
+
+    @jax.jit
+    def step(syn0, syn1, syn1neg, centers, contexts, lr, rng):
+        trainables = {"syn0": syn0}
+        if K > 0:
+            trainables["syn1neg"] = syn1neg
+            negatives = sampler.sample(rng, centers.shape + (K,))
+        else:
+            negatives = None
+        if use_hs:
+            trainables["syn1"] = syn1
+        loss, grads = jax.value_and_grad(loss_fn)(trainables, centers,
+                                                  contexts, negatives)
+        new0 = syn0 - lr * grads["syn0"]
+        new1 = syn1 - lr * grads["syn1"] if use_hs else syn1
+        new1n = syn1neg - lr * grads["syn1neg"] if K > 0 else syn1neg
+        return new0, new1, new1n, loss / centers.shape[0]
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Query-side API (BasicModelUtils parity)
+# ---------------------------------------------------------------------------
+
+class WordVectorsModel:
+    """similarity / wordsNearest over a lookup table (reference
+    `reader/impl/BasicModelUtils.java`)."""
+
+    def __init__(self, vocab: VocabCache, table: InMemoryLookupTable):
+        self.vocab = vocab
+        self.lookup_table = table
+
+    def has_word(self, w: str) -> bool:
+        return self.vocab.contains_word(w)
+
+    def word_vector(self, w: str) -> Optional[np.ndarray]:
+        return self.lookup_table.vector(w)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.word_vector(a), self.word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        d = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(np.dot(va, vb) / d) if d else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10,
+                      exclude: Sequence[str] = ()) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.word_vector(word_or_vec)
+            exclude = list(exclude) + [word_or_vec]
+            if vec is None:
+                return []
+        else:
+            vec = np.asarray(word_or_vec)
+        m = self.lookup_table.vectors_matrix()
+        norms = np.linalg.norm(m, axis=1) * (np.linalg.norm(vec) + 1e-12)
+        sims = m @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w in exclude:
+                continue
+            vw = self.vocab.element_at_index(int(i))
+            if vw is not None and vw.is_label:
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive: Sequence[str],
+                          negative: Sequence[str], top_n: int = 10):
+        """king - man + woman style analogy queries."""
+        vec = np.zeros(self.lookup_table.vector_length, np.float32)
+        for w in positive:
+            v = self.word_vector(w)
+            if v is not None:
+                vec += v
+        for w in negative:
+            v = self.word_vector(w)
+            if v is not None:
+                vec -= v
+        return self.words_nearest(vec, top_n,
+                                  exclude=list(positive) + list(negative))

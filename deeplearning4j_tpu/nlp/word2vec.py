@@ -1,0 +1,330 @@
+"""SequenceVectors engine + Word2Vec + ParagraphVectors.
+
+Parity with:
+  * SequenceVectors (`models/sequencevectors/SequenceVectors.java:51`) — the
+    generic trainer over element sequences (words, labelled docs, graph
+    walks), with elements_learning_algorithm (SkipGram/CBOW) and
+    sequence_learning_algorithm (DBOW/DM)
+  * Word2Vec (`models/word2vec/Word2Vec.java:32`) — builder config: layer
+    size, window, min word frequency, negative sampling, HS, subsampling,
+    lr linear decay to min_learning_rate
+  * ParagraphVectors (`models/paragraphvectors/ParagraphVectors.java`) —
+    DBOW/DM with label vectors in the shared lookup table + `infer_vector`
+    for unseen documents
+
+TPU-first: the Hogwild worker threads (`SequenceVectors.java:289`) are
+replaced by host-side pair generation + device-batched SGD (see
+`embeddings.py`); accuracy targets are the reference's NLP suite style
+(similarity sanity, nearest-neighbor checks) rather than bitwise parity.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .embeddings import (InMemoryLookupTable, WordVectorsModel,
+                         make_cbow_step, make_skipgram_step)
+from .sentence_iterator import (BasicLabelAwareIterator, LabelAwareIterator,
+                                LabelsSource, SentenceIterator)
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, VocabConstructor, VocabWord
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["SequenceVectors", "Word2Vec", "ParagraphVectors"]
+
+
+class SequenceVectors(WordVectorsModel):
+    """Generic embedding trainer over sequences of string elements."""
+
+    def __init__(self,
+                 layer_size: int = 100,
+                 window_size: int = 5,
+                 min_word_frequency: int = 1,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 negative: int = 5,
+                 use_hierarchic_softmax: bool = False,
+                 sampling: float = 0.0,
+                 epochs: int = 1,
+                 batch_size: int = 512,
+                 seed: int = 12345,
+                 elements_learning_algorithm: str = "skipgram",
+                 sequence_learning_algorithm: str = "dbow",
+                 train_elements: bool = True,
+                 train_sequences: bool = False):
+        self.layer_size = int(layer_size)
+        self.window_size = int(window_size)
+        self.min_word_frequency = int(min_word_frequency)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hierarchic_softmax)
+        self.sampling = float(sampling)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.elements_algo = elements_learning_algorithm.lower()
+        self.sequence_algo = sequence_learning_algorithm.lower()
+        self.train_elements = train_elements
+        self.train_sequences = train_sequences
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._np_rng = np.random.default_rng(seed)
+
+    # -- corpus plumbing (overridden by subclasses) ---------------------
+    def _sequences(self) -> Iterable[Tuple[List[str], List[str]]]:
+        """Yield (tokens, labels) pairs."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def build_vocab(self):
+        seqs = list(self._sequences())
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(
+            (toks for toks, _ in seqs), (labels for _, labels in seqs))
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, seed=self.seed,
+            use_hs=self.use_hs, negative=self.negative)
+        return seqs
+
+    def _subsample(self, idx: np.ndarray) -> np.ndarray:
+        """Frequent-word subsampling (reference `sampling` config)."""
+        if self.sampling <= 0:
+            return idx
+        counts = self.vocab.counts_array()
+        total = counts.sum()
+        freq = counts[idx] / total
+        keep_p = np.minimum(1.0, np.sqrt(self.sampling / freq)
+                            + self.sampling / freq)
+        return idx[self._np_rng.random(len(idx)) < keep_p]
+
+    def _to_indices(self, tokens: Sequence[str]) -> np.ndarray:
+        idx = [self.vocab.index_of(t) for t in tokens]
+        return np.array([i for i in idx if i >= 0], np.int32)
+
+    def _gen_pairs(self, seqs) -> Dict[str, np.ndarray]:
+        """Generate training examples host-side (vectorized per sentence)."""
+        sg_c, sg_x = [], []
+        cb_c, cb_x = [], []
+        seq_c, seq_x = [], []
+        W = self.window_size
+        cbow = self.elements_algo == "cbow"
+        dm = self.sequence_algo == "dm"
+        for tokens, labels in seqs:
+            idx = self._subsample(self._to_indices(tokens))
+            n = len(idx)
+            if n < 2 and not labels:
+                continue
+            label_idx = [self.vocab.index_of(l) for l in labels]
+            label_idx = [i for i in label_idx if i >= 0]
+            bs = self._np_rng.integers(1, W + 1, n) if n else np.zeros(0, int)
+            for i in range(n):
+                b = bs[i]
+                lo, hi = max(0, i - b), min(n, i + b + 1)
+                ctx = np.concatenate([idx[lo:i], idx[i + 1:hi]])
+                if len(ctx) == 0:
+                    continue
+                if self.train_elements:
+                    if cbow:
+                        pad = np.full(2 * W, -1, np.int32)
+                        pad[:len(ctx)] = ctx[:2 * W]
+                        cb_c.append(idx[i])
+                        cb_x.append(pad)
+                    else:
+                        for c in ctx:
+                            sg_c.append(idx[i])
+                            sg_x.append(c)
+                if self.train_sequences and label_idx:
+                    if dm:
+                        # DM: doc vector joins the averaged context
+                        pad = np.full(2 * W + 1, -1, np.int32)
+                        pad[:min(len(ctx), 2 * W)] = ctx[:2 * W]
+                        pad[-1] = label_idx[0]
+                        seq_c.append(idx[i])
+                        seq_x.append(pad)
+                    else:
+                        # DBOW: doc vector predicts each word
+                        for l in label_idx:
+                            seq_c.append(l)
+                            seq_x.append(idx[i])
+        out = {}
+        if sg_c:
+            out["sg"] = (np.array(sg_c, np.int32), np.array(sg_x, np.int32))
+        if cb_c:
+            out["cb"] = (np.array(cb_c, np.int32), np.stack(cb_x))
+        if seq_c:
+            if dm:
+                out["dm"] = (np.array(seq_c, np.int32), np.stack(seq_x))
+            else:
+                out["dbow"] = (np.array(seq_c, np.int32),
+                               np.array(seq_x, np.int32))
+        return out
+
+    # ------------------------------------------------------------------
+    def fit(self):
+        seqs = self.build_vocab() if self.vocab is None else list(
+            self._sequences())
+        table = self.lookup_table
+        sg_step = make_skipgram_step(table)
+        cb_step = (make_cbow_step(table, self.window_size)
+                   if (self.elements_algo == "cbow"
+                       or self.sequence_algo == "dm") else None)
+        rng = jax.random.PRNGKey(self.seed)
+        syn0, syn1, syn1neg = table.syn0, table.syn1, table.syn1neg
+        if syn1 is None:
+            syn1 = jnp.zeros((1, 1), jnp.float32)
+        if syn1neg is None:
+            syn1neg = jnp.zeros((1, 1), jnp.float32)
+
+        for epoch in range(self.epochs):
+            pairs = self._gen_pairs(seqs)
+            tasks = []
+            if "sg" in pairs:
+                tasks.append(("sg", sg_step) + pairs["sg"])
+            if "cb" in pairs:
+                tasks.append(("cb", cb_step) + pairs["cb"])
+            if "dm" in pairs:
+                # DM trains through the cbow step with doc in context
+                dm_step = cb_step or make_cbow_step(table, self.window_size)
+                tasks.append(("dm", dm_step) + pairs["dm"])
+            if "dbow" in pairs:
+                tasks.append(("dbow", sg_step) + pairs["dbow"])
+            total = sum(len(t[2]) for t in tasks) * self.epochs or 1
+            done = epoch * (total // self.epochs)
+            for kind, step, centers, contexts in tasks:
+                n = len(centers)
+                perm = self._np_rng.permutation(n)
+                centers, contexts = centers[perm], contexts[perm]
+                B = self.batch_size
+                pad = (-n) % B
+                if pad:
+                    centers = np.concatenate([centers, centers[:pad]])
+                    if contexts.ndim == 1:
+                        contexts = np.concatenate([contexts, contexts[:pad]])
+                    else:
+                        contexts = np.concatenate([contexts, contexts[:pad]],
+                                                  axis=0)
+                for i in range(0, len(centers), B):
+                    frac = min(1.0, done / total)
+                    lr = max(self.min_learning_rate,
+                             self.learning_rate * (1.0 - frac))
+                    rng, k = jax.random.split(rng)
+                    syn0, syn1, syn1neg, loss = step(
+                        syn0, syn1, syn1neg,
+                        jnp.asarray(centers[i:i + B]),
+                        jnp.asarray(contexts[i:i + B]),
+                        jnp.float32(lr), k)
+                    done += B
+        table.syn0 = syn0
+        if table.use_hs:
+            table.syn1 = syn1
+        if table.negative > 0:
+            table.syn1neg = syn1neg
+        return self
+
+
+class Word2Vec(SequenceVectors):
+    """Reference builder parity: Word2Vec.Builder().layerSize(..).windowSize(..)
+    ... here as constructor kwargs + `Builder` alias."""
+
+    def __init__(self, sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kw):
+        kw.setdefault("train_elements", True)
+        kw.setdefault("train_sequences", False)
+        super().__init__(**kw)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _sequences(self):
+        self.sentence_iterator.reset()
+        while self.sentence_iterator.has_next():
+            s = self.sentence_iterator.next_sentence()
+            yield self.tokenizer_factory.create(s).get_tokens(), []
+
+
+class ParagraphVectors(SequenceVectors):
+    """DBOW/DM document embeddings; labels live in the shared vocab/lookup
+    (reference ParagraphVectors)."""
+
+    def __init__(self, iterator: Optional[LabelAwareIterator] = None,
+                 sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kw):
+        kw.setdefault("train_elements", False)
+        kw.setdefault("train_sequences", True)
+        super().__init__(**kw)
+        if iterator is None and sentence_iterator is not None:
+            iterator = BasicLabelAwareIterator(sentence_iterator)
+        self.iterator = iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _sequences(self):
+        self.iterator.reset()
+        while self.iterator.has_next_document():
+            doc = self.iterator.next_document()
+            toks = self.tokenizer_factory.create(doc.content).get_tokens()
+            yield toks, list(doc.labels)
+
+    # -- label-space queries -------------------------------------------
+    def labels(self) -> List[str]:
+        return [vw.word for vw in self.vocab.vocab_words() if vw.is_label]
+
+    def label_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.word_vector(label)
+
+    def nearest_labels(self, vec_or_text, top_n: int = 10) -> List[str]:
+        if isinstance(vec_or_text, str):
+            vec = self.infer_vector(vec_or_text)
+        else:
+            vec = np.asarray(vec_or_text)
+        m = self.lookup_table.vectors_matrix()
+        sims = {}
+        for vw in self.vocab.vocab_words():
+            if not vw.is_label:
+                continue
+            v = m[vw.index]
+            d = np.linalg.norm(v) * (np.linalg.norm(vec) + 1e-12)
+            sims[vw.word] = float(v @ vec / d) if d else 0.0
+        return sorted(sims, key=sims.get, reverse=True)[:top_n]
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: float = 0.025) -> np.ndarray:
+        """Train a fresh doc vector against the FROZEN tables (reference
+        `inferVector`)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        idx = self._to_indices(toks)
+        if len(idx) == 0:
+            return np.zeros(self.layer_size, np.float32)
+        table = self.lookup_table
+        D = self.layer_size
+        rng = jax.random.PRNGKey(abs(hash(text)) % (2 ** 31))
+        vec = jax.random.uniform(rng, (D,), jnp.float32, -0.5 / D, 0.5 / D)
+        words = jnp.asarray(idx)
+        syn1neg = table.syn1neg if table.negative > 0 else None
+        sampler = table.sampler
+
+        def loss_fn(v, negs):
+            # DBOW inference: doc vector predicts each observed word
+            up = syn1neg[words]
+            pos = jax.nn.log_sigmoid(up @ v)
+            un = syn1neg[negs]                     # [N, K, D]
+            neg = jnp.sum(jax.nn.log_sigmoid(-jnp.einsum(
+                "d,nkd->nk", v, un)), axis=-1)
+            return -jnp.sum(pos + neg)
+
+        @jax.jit
+        def step(v, lr, k):
+            negs = sampler.sample(k, (len(idx), max(1, table.negative)))
+            l, g = jax.value_and_grad(loss_fn)(v, negs)
+            return v - lr * g, l
+
+        for t in range(steps):
+            rng, k = jax.random.split(rng)
+            lr = learning_rate * (1.0 - t / steps)
+            vec, _ = step(vec, jnp.float32(lr), k)
+        return np.asarray(vec)

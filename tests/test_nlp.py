@@ -1,0 +1,255 @@
+"""NLP tests (reference: 42 classes under deeplearning4j-nlp/src/test —
+similarity/nearest-word sanity assertions on small corpora, tokenizer and
+vocab unit tests, serializer round-trips)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer,
+                                    BasicLabelAwareIterator,
+                                    CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, Glove, Huffman,
+                                    LabelsSource, LineSentenceIterator,
+                                    NGramTokenizerFactory, ParagraphVectors,
+                                    TfidfVectorizer, VocabCache,
+                                    VocabConstructor, Word2Vec,
+                                    WordVectorSerializer)
+
+
+def topic_corpus(n_sent=300, seed=0):
+    """Sentences drawn from 3 disjoint-topic vocabularies."""
+    topics = [
+        ["cat", "dog", "pet", "fur", "paw", "tail", "kitten", "puppy"],
+        ["car", "road", "wheel", "engine", "drive", "fuel", "tire", "brake"],
+        ["rain", "cloud", "storm", "wind", "snow", "sun", "sky", "weather"],
+    ]
+    r = np.random.default_rng(seed)
+    sentences = []
+    for _ in range(n_sent):
+        t = topics[r.integers(0, 3)]
+        sentences.append(" ".join(r.choice(t, size=8)))
+    return sentences, topics
+
+
+# --------------------------- tokenization ----------------------------------
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo").get_tokens()
+    assert toks == ["hello", "world", "foo"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.create("a b c").get_tokens()
+    assert "a" in toks and "a b" in toks and "b c" in toks
+
+
+# --------------------------- vocab + huffman --------------------------------
+
+def test_vocab_constructor_min_frequency():
+    seqs = [["a", "a", "a", "b", "b", "c"]]
+    vocab = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert vocab.contains_word("a") and vocab.contains_word("b")
+    assert not vocab.contains_word("c")
+    assert vocab.index_of("a") == 0  # most frequent first
+
+
+def test_huffman_codes():
+    seqs = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+    vocab = VocabConstructor().build_vocab(seqs)
+    Huffman(vocab).build()
+    wa = vocab.word_for("a")
+    wd = vocab.word_for("d")
+    # more frequent word gets shorter code
+    assert len(wa.code) <= len(wd.code)
+    # prefix-free: no code is a prefix of another
+    codes = ["".join(map(str, vocab.word_for(w).code)) for w in "abcd"]
+    for i, c1 in enumerate(codes):
+        for j, c2 in enumerate(codes):
+            if i != j:
+                assert not c2.startswith(c1)
+
+
+def test_labels_source():
+    ls = LabelsSource("DOC_%d")
+    assert ls.next_label() == "DOC_0"
+    assert ls.next_label() == "DOC_1"
+    assert ls.index_of("DOC_1") == 1
+
+
+# --------------------------- word2vec ---------------------------------------
+
+def _train_w2v(**kw):
+    sentences, topics = topic_corpus()
+    it = CollectionSentenceIterator(sentences)
+    defaults = dict(layer_size=32, window_size=4, min_word_frequency=3,
+                    epochs=3, seed=1, negative=5, batch_size=256)
+    defaults.update(kw)
+    w2v = Word2Vec(sentence_iterator=it, **defaults)
+    w2v.fit()
+    return w2v, topics
+
+
+def _topic_separation(model, topics):
+    intra, inter = [], []
+    for ti, t in enumerate(topics):
+        for i, a in enumerate(t):
+            for b in t[i + 1:]:
+                intra.append(model.similarity(a, b))
+            for tj in range(ti + 1, 3):
+                for b in topics[tj]:
+                    inter.append(model.similarity(a, b))
+    return float(np.mean(intra)), float(np.mean(inter))
+
+
+def test_word2vec_skipgram_negative_sampling_learns_topics():
+    w2v, topics = _train_w2v()
+    intra, inter = _topic_separation(w2v, topics)
+    assert intra > inter + 0.2, (intra, inter)
+    near = w2v.words_nearest("cat", 5)
+    same_topic = sum(1 for w in near if w in topics[0])
+    assert same_topic >= 3, near
+
+
+def test_word2vec_hierarchical_softmax():
+    w2v, topics = _train_w2v(negative=0, use_hierarchic_softmax=True)
+    intra, inter = _topic_separation(w2v, topics)
+    assert intra > inter + 0.15, (intra, inter)
+
+
+def test_word2vec_cbow():
+    w2v, topics = _train_w2v(elements_learning_algorithm="cbow", epochs=5)
+    intra, inter = _topic_separation(w2v, topics)
+    assert intra > inter + 0.15, (intra, inter)
+
+
+def test_word2vec_query_api():
+    w2v, topics = _train_w2v(epochs=1)
+    assert w2v.has_word("cat")
+    assert not w2v.has_word("zebra")
+    v = w2v.word_vector("cat")
+    assert v.shape == (32,)
+    assert np.isfinite(w2v.similarity("cat", "dog"))
+    assert np.isnan(w2v.similarity("cat", "zebra"))
+    res = w2v.words_nearest_sum(["cat", "dog"], ["car"], top_n=3)
+    assert len(res) == 3
+
+
+# --------------------------- serializer -------------------------------------
+
+def test_word_vector_serializer_text_roundtrip(tmp_path):
+    w2v, _ = _train_w2v(epochs=1)
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p, header=True)
+    back = WordVectorSerializer.read_word_vectors(p)
+    assert back.vocab.num_words() == w2v.vocab.num_words()
+    np.testing.assert_allclose(back.word_vector("cat"),
+                               w2v.word_vector("cat"), atol=1e-5)
+
+
+def test_word_vector_serializer_binary_roundtrip(tmp_path):
+    w2v, _ = _train_w2v(epochs=1)
+    p = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(w2v, p)
+    back = WordVectorSerializer.read_binary(p)
+    np.testing.assert_allclose(back.word_vector("dog"),
+                               w2v.word_vector("dog"), atol=1e-6)
+
+
+def test_word2vec_model_zip_roundtrip(tmp_path):
+    w2v, _ = _train_w2v(epochs=1)
+    p = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_word2vec_model(w2v, p)
+    back = WordVectorSerializer.read_word2vec_model(p)
+    np.testing.assert_allclose(back.word_vector("cat"),
+                               w2v.word_vector("cat"), atol=1e-5)
+    assert back.vocab.word_frequency("cat") == w2v.vocab.word_frequency("cat")
+
+
+# --------------------------- paragraph vectors -------------------------------
+
+def test_paragraph_vectors_dbow_groups_topics():
+    sentences, topics = topic_corpus(n_sent=120)
+    labels = []
+    r = np.random.default_rng(0)
+    # label = topic id of the sentence (derivable from the first word)
+    word2topic = {w: i for i, t in enumerate(topics) for w in t}
+    from deeplearning4j_tpu.nlp import CollectionLabeledSentenceIterator
+    labels = [f"T{word2topic[s.split()[0]]}" for s in sentences]
+    it = CollectionLabeledSentenceIterator(sentences, labels)
+    pv = ParagraphVectors(iterator=it, layer_size=24, window_size=4,
+                          min_word_frequency=2, epochs=5, seed=3,
+                          negative=5, train_elements=True)
+    pv.fit()
+    assert set(pv.labels()) == {"T0", "T1", "T2"}
+    # label vectors should separate by topic of inferred text
+    inferred = pv.infer_vector("cat dog kitten paw fur pet")
+    near = pv.nearest_labels(inferred, top_n=1)
+    assert near[0] == "T0", near
+
+
+def test_infer_vector_deterministic():
+    sentences, _ = topic_corpus(n_sent=60)
+    pv = ParagraphVectors(
+        sentence_iterator=CollectionSentenceIterator(sentences),
+        layer_size=16, epochs=1, seed=5, min_word_frequency=2)
+    pv.fit()
+    v1 = pv.infer_vector("cat dog pet")
+    v2 = pv.infer_vector("cat dog pet")
+    np.testing.assert_allclose(v1, v2, atol=1e-6)
+
+
+# --------------------------- glove -------------------------------------------
+
+def test_glove_learns_topics():
+    sentences, topics = topic_corpus(n_sent=300)
+    g = Glove(sentence_iterator=CollectionSentenceIterator(sentences),
+              layer_size=24, window=6, min_word_frequency=3, epochs=30,
+              seed=2)
+    g.fit()
+    intra, inter = _topic_separation(g, topics)
+    assert intra > inter + 0.15, (intra, inter)
+
+
+# --------------------------- bow / tfidf -------------------------------------
+
+def test_bag_of_words():
+    docs = ["the cat sat", "the dog sat", "cat and dog"]
+    bow = BagOfWordsVectorizer(CollectionSentenceIterator(docs))
+    m = bow.fit_transform()
+    assert m.shape == (3, bow.vocab.num_words())
+    i_cat = bow.vocab.index_of("cat")
+    assert m[0, i_cat] == 1 and m[1, i_cat] == 0 and m[2, i_cat] == 1
+
+
+def test_tfidf():
+    docs = ["cat cat dog", "dog fish", "fish bird"]
+    tv = TfidfVectorizer(CollectionSentenceIterator(docs))
+    tv.fit()
+    v = tv.transform("cat cat dog")
+    i_cat = tv.vocab.index_of("cat")
+    i_dog = tv.vocab.index_of("dog")
+    # cat appears in 1/3 docs, dog in 2/3 -> cat idf > dog idf; cat tf also higher
+    assert v[i_cat] > v[i_dog] > 0
+    assert tv.idf("cat") > tv.idf("dog")
+
+
+# --------------------------- iterators ---------------------------------------
+
+def test_line_sentence_iterator(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("first line\n\nsecond line\nthird\n")
+    it = LineSentenceIterator(str(p))
+    assert list(it) == ["first line", "second line", "third"]
+    it.reset()
+    assert it.next_sentence() == "first line"
+
+
+def test_basic_label_aware_iterator():
+    it = BasicLabelAwareIterator(
+        CollectionSentenceIterator(["a b", "c d"]))
+    docs = list(it)
+    assert [d.labels[0] for d in docs] == ["DOC_0", "DOC_1"]
+    assert it.get_labels_source().size() == 2
