@@ -1,76 +1,105 @@
 #!/usr/bin/env python
 """Benchmark driver — prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
 
-Metric (BASELINE.md): samples/sec/chip on the flagship config. The reference
-publishes no numbers (BASELINE.json "published": {}), so vs_baseline is the
-ratio against the first measured value recorded here.
+Covers all five BASELINE.md configs:
+  1. LeNet-MNIST samples/sec            (zoo.bench_lenet)
+  2. ResNet-50 ImageNet samples/sec     (zoo.bench_resnet50, bf16 b256) - headline
+  3. GravesLSTM char-RNN tokens/sec     (zoo.bench_char_rnn)
+  4. Word2Vec skip-gram NS words/sec    (bench_word2vec, zipf corpus)
+  5. DP weak-scaling efficiency, 8-dev virtual mesh (parallel.scaling_bench,
+     subprocess so it can force the CPU platform)
 
-Currently benches: LeNet-style MNIST config if available, else the MLP slice.
-Runs on the real TPU chip (default jax platform).
+The reference publishes no numbers (BASELINE.json "published": {}), so
+vs_baseline is the ratio against round-1's first measured value
+(BENCH_BASELINE.json).
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
 
+def bench_word2vec(n_sentences=20000, sent_len=20, vocab=10000, epochs=1,
+                   batch_words=8192):
+    """words/sec for batched skip-gram negative sampling (BASELINE #4) on a
+    synthetic zipf corpus (throughput; accuracy is covered by tests/test_nlp)."""
+    import numpy as np
 
-def bench_mlp(batch=256, steps=50, warmup=5):
-    import jax
-    from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
-                                    MultiLayerNetwork,
-                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nlp.sentence_iterator import (
+        CollectionSentenceIterator)
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(42).updater(Adam(1e-3))
-            .list()
-            .layer(DenseLayer(n_out=1024, activation="relu"))
-            .layer(DenseLayer(n_out=1024, activation="relu"))
-            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
-            .set_input_type(InputType.feed_forward(784))
-            .build())
-    model = MultiLayerNetwork(conf).init()
     r = np.random.default_rng(0)
-    x = r.normal(size=(batch, 784)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
-    ds = DataSet(x, y)
-    for _ in range(warmup):
-        model.fit(ds)
-    jax.block_until_ready(model.params)
+    words = r.zipf(1.2, size=(n_sentences, sent_len)) % vocab
+    sents = [" ".join(f"w{w}" for w in row) for row in words]
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                   layer_size=128, window_size=5, negative=5,
+                   min_word_frequency=1, epochs=epochs,
+                   batch_size=batch_words, seed=7)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        model.fit(ds)
-    jax.block_until_ready(model.params)
+    w2v.fit()
     dt = time.perf_counter() - t0
-    return batch * steps / dt, "MLP-784-1024-1024-10"
+    total_words = n_sentences * sent_len * epochs
+    return total_words / dt, "Word2Vec-SGNS-words"
+
+
+def bench_scaling(devices=8):
+    """Weak-scaling efficiency on the virtual CPU mesh, in a subprocess so the
+    parent's TPU-initialized jax doesn't pin the platform."""
+    from deeplearning4j_tpu.util.platform import (
+        child_env_with_virtual_devices)
+
+    env = child_env_with_virtual_devices(devices)
+    out = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
+         "--devices", str(devices), "--global-batch", "1024",
+         "--steps", "10"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        return None
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def main():
-    try:
-        from deeplearning4j_tpu.models import zoo  # noqa: F401
-        has_lenet = hasattr(zoo, "lenet_mnist")
-    except Exception:
-        has_lenet = False
+    from deeplearning4j_tpu.models.zoo import (bench_char_rnn, bench_lenet,
+                                               bench_resnet50)
 
-    if has_lenet:
-        from deeplearning4j_tpu.models.zoo import bench_lenet
-        sps, name = bench_lenet()
-    else:
-        sps, name = bench_mlp()
-
-    # First measured value becomes the baseline (reference publishes none).
-    baseline = None
+    extras = {}
+    lenet_sps, _ = bench_lenet()
+    extras["LeNet-MNIST"] = round(lenet_sps, 1)
+    resnet_sps, _ = bench_resnet50()
+    extras["ResNet50-ImageNet"] = round(resnet_sps, 1)
+    rnn_tps, _ = bench_char_rnn()
+    extras["charRNN-tokens"] = round(rnn_tps, 1)
     try:
-        with open("BENCH_BASELINE.json") as f:
-            baseline = json.load(f).get(name)
+        w2v_wps, _ = bench_word2vec()
+        extras["Word2Vec-SGNS-words"] = round(w2v_wps, 1)
+    except Exception as e:  # keep the headline alive if NLP bench breaks
+        extras["Word2Vec-SGNS-words"] = f"error: {type(e).__name__}"
+    try:
+        sc = bench_scaling(8)
+        if sc:
+            extras["DP-weak-scaling-8dev"] = sc["efficiency"]
     except Exception:
         pass
-    vs = sps / baseline if baseline else 1.0
+
+    baseline = None
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_BASELINE.json")) as f:
+            baseline = json.load(f).get("ResNet50-ImageNet")
+    except Exception:
+        pass
+    vs = resnet_sps / baseline if baseline else 1.0
     print(json.dumps({
-        "metric": f"samples/sec/chip ({name})",
-        "value": round(sps, 2),
+        "metric": "samples/sec/chip (ResNet50-ImageNet, bf16 b256)",
+        "value": round(resnet_sps, 2),
         "unit": "samples/sec",
         "vs_baseline": round(vs, 3),
+        "extras": extras,
     }))
 
 

@@ -36,6 +36,27 @@ class DataSet:
     labels: Optional[np.ndarray] = None
     features_mask: Optional[np.ndarray] = None
     labels_mask: Optional[np.ndarray] = None
+    # device-array cache: (id-key, (features, labels, fmask, lmask) on device)
+    _dev_cache: Optional[tuple] = field(default=None, repr=False, compare=False)
+
+    def device_tuple(self):
+        """(features, labels, features_mask, labels_mask) as device arrays,
+        cached so refitting the same DataSet pays host->device transfer once
+        (the transfer, not compute, dominates through a thin host link).
+
+        The cache holds references to the host arrays and is invalidated when
+        any field is REASSIGNED (`is` comparison — shuffle() etc. do this).
+        In-place mutation of a field (`ds.features[:] = ...`) is not detected;
+        DataSet fields are treated as immutable buffers."""
+        import jax.numpy as jnp
+        arrays = (self.features, self.labels, self.features_mask,
+                  self.labels_mask)
+        if (self._dev_cache is None
+                or any(a is not b
+                       for a, b in zip(self._dev_cache[0], arrays))):
+            dev = tuple(None if a is None else jnp.asarray(a) for a in arrays)
+            self._dev_cache = (arrays, dev)
+        return self._dev_cache[1]
 
     def num_examples(self) -> int:
         return int(self.features.shape[0])
@@ -98,6 +119,31 @@ class MultiDataSet:
     labels: List[np.ndarray] = field(default_factory=list)
     features_masks: Optional[List[Optional[np.ndarray]]] = None
     labels_masks: Optional[List[Optional[np.ndarray]]] = None
+    _dev_cache: Optional[tuple] = field(default=None, repr=False, compare=False)
+
+    def device_tuple(self):
+        """(features, labels, features_masks, labels_masks) with every array
+        on device, cached (see DataSet.device_tuple for invalidation rules)."""
+        import jax.numpy as jnp
+
+        def conv(seq):
+            if seq is None:
+                return None
+            return tuple(None if a is None else jnp.asarray(a) for a in seq)
+
+        def flat(seq):
+            return tuple(seq) if seq is not None else (None,)
+
+        key = flat(self.features) + flat(self.labels) \
+            + flat(self.features_masks) + flat(self.labels_masks)
+        if (self._dev_cache is None
+                or len(self._dev_cache[0]) != len(key)
+                or any(a is not b
+                       for a, b in zip(self._dev_cache[0], key))):
+            self._dev_cache = (key, (conv(self.features), conv(self.labels),
+                                     conv(self.features_masks),
+                                     conv(self.labels_masks)))
+        return self._dev_cache[1]
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])

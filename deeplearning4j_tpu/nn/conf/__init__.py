@@ -82,6 +82,9 @@ class NeuralNetConfiguration:
     minimize: bool = True
     mini_batch: bool = True
     dtype: str = "float32"
+    # Mixed precision: compute in this dtype (e.g. "bfloat16" for the MXU)
+    # while master params/updater state stay in `dtype`. None = same as dtype.
+    compute_dtype: Optional[str] = None
 
     @staticmethod
     def builder() -> "NeuralNetConfigurationBuilder":
@@ -204,6 +207,12 @@ class NeuralNetConfigurationBuilder:
 
     def dtype(self, dt):
         self._c.dtype = str(dt); return self
+
+    def compute_dtype(self, dt):
+        """bf16 compute + f32 master weights: `.compute_dtype("bfloat16")`.
+        The TPU-native analog of the reference's cuDNN half-precision math
+        mode (`CudnnConvolutionHelper.java` TENSOR_OP paths)."""
+        self._c.compute_dtype = None if dt is None else str(dt); return self
 
     def build(self) -> NeuralNetConfiguration:
         return self._c

@@ -33,10 +33,21 @@ from ..weights import Distribution, WeightInit, init_weight
 
 __all__ = [
     "LayerConf", "register_layer", "layer_from_dict", "conf_to_dict",
-    "conf_from_dict", "LAYER_REGISTRY", "MaskState",
+    "conf_from_dict", "LAYER_REGISTRY", "MaskState", "cast_floating",
 ]
 
 LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating-point leaf of a pytree to `dtype` (mixed-precision
+    compute cast; integer leaves untouched). Differentiable: under `jax.grad`
+    the cast's cotangent comes back in the master dtype."""
+    def c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(dtype)
+        return a
+    return jax.tree_util.tree_map(c, tree)
 
 
 class MaskState:

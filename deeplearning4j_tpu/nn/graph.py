@@ -16,8 +16,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import functools as _functools
+
 from .conf import NeuralNetConfiguration
-from .conf.base import LayerConf
+from .conf.base import LayerConf, cast_floating
 from .conf.graph import ComputationGraphConfiguration, GraphVertex
 from .gradnorm import apply_gradient_normalization
 from .layers.feedforward import BaseOutputLayerConf
@@ -54,6 +56,10 @@ class ComputationGraph:
         return self.conf.topological_order
 
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        from . import activations as _acts
+        for layer in self.layer_vertices.values():
+            if layer.activation is not None:  # fail fast on bad names
+                _acts.get(layer.activation)
         seed = self.conf.conf.seed if seed is None else seed
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)
@@ -90,6 +96,14 @@ class ComputationGraph:
         return (layer.updater if isinstance(layer, LayerConf) and layer.updater
                 else self.conf.conf.updater)
 
+    @_functools.cached_property
+    def _compute_dtype(self):
+        """jnp dtype for mixed-precision compute, or None when disabled."""
+        cdt = self.conf.conf.compute_dtype
+        if cdt is None or jnp.dtype(cdt) == jnp.dtype(self.conf.conf.dtype):
+            return None
+        return jnp.dtype(cdt)
+
     # ------------------------------------------------------------------
     # Functional core
     # ------------------------------------------------------------------
@@ -99,6 +113,11 @@ class ComputationGraph:
         """Execute vertices in topo order. Returns (values, masks, new_state).
         Output-layer vertices contribute their *pre-activation input* (the
         caller applies loss or activation)."""
+        cdt = self._compute_dtype
+        if cdt is not None:
+            inputs = {k: (v.astype(cdt)
+                          if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                          else v) for k, v in inputs.items()}
         values: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
         for k in self.conf.network_inputs:
@@ -125,7 +144,12 @@ class ComputationGraph:
                     values[name] = (x, m)  # defer loss/activation to caller
                     masks[name] = m
                     continue
-                y, new_state[name] = v.apply(params[name], state[name], x,
+                p_v = params[name]
+                # Mixed precision: hidden vertices compute in cdt; output
+                # layers keep master-dtype params (see MultiLayerNetwork).
+                if cdt is not None and not isinstance(v, BaseOutputLayerConf):
+                    p_v = cast_floating(p_v, cdt)
+                y, new_state[name] = v.apply(p_v, state[name], x,
                                              train=train, rng=rngs[i], mask=m)
                 values[name] = y
                 masks[name] = m
@@ -243,22 +267,16 @@ class ComputationGraph:
             if len(ins) != 1 or len(outs) != 1:
                 raise ValueError("DataSet fits single-input/single-output "
                                  "graphs; use MultiDataSet")
-            inputs = {ins[0]: jnp.asarray(ds.features)}
-            labels = {outs[0]: jnp.asarray(ds.labels)}
-            fmasks = {ins[0]: None if ds.features_mask is None
-                      else jnp.asarray(ds.features_mask)}
-            lmasks = {outs[0]: None if ds.labels_mask is None
-                      else jnp.asarray(ds.labels_mask)}
-            return inputs, labels, fmasks, lmasks
+            x, y, fm, lm = ds.device_tuple()
+            return ({ins[0]: x}, {outs[0]: y}, {ins[0]: fm}, {outs[0]: lm})
         if isinstance(ds, MultiDataSet):
-            inputs = {n: jnp.asarray(f) for n, f in zip(ins, ds.features)}
-            labels = {n: jnp.asarray(l) for n, l in zip(outs, ds.labels)}
-            fm = ds.features_masks or [None] * len(ins)
-            lm = ds.labels_masks or [None] * len(outs)
-            fmasks = {n: (None if m is None else jnp.asarray(m))
-                      for n, m in zip(ins, fm)}
-            lmasks = {n: (None if m is None else jnp.asarray(m))
-                      for n, m in zip(outs, lm)}
+            f, l, fm, lm = ds.device_tuple()
+            inputs = dict(zip(ins, f))
+            labels = dict(zip(outs, l))
+            fm = fm or (None,) * len(ins)
+            lm = lm or (None,) * len(outs)
+            fmasks = dict(zip(ins, fm))
+            lmasks = dict(zip(outs, lm))
             return inputs, labels, fmasks, lmasks
         raise TypeError(f"Cannot fit on {type(ds)}")
 

@@ -10,7 +10,7 @@ from .convolution import (
 from .normalization import BatchNormalization, LocalResponseNormalization
 from .pooling import GlobalPoolingLayer
 from .recurrent import (GravesLSTM, GravesBidirectionalLSTM, RnnOutputLayer,
-                        BaseRecurrentLayer)
+                        BaseRecurrentLayer, LastTimeStep)
 from .generative import (AutoEncoder, RBM, VariationalAutoencoder,
                          CenterLossOutputLayer,
                          GaussianReconstructionDistribution,
@@ -26,7 +26,7 @@ __all__ = [
     "PoolingType", "BatchNormalization", "LocalResponseNormalization",
     "GlobalPoolingLayer",
     "GravesLSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
-    "BaseRecurrentLayer",
+    "BaseRecurrentLayer", "LastTimeStep",
     "AutoEncoder", "RBM", "VariationalAutoencoder", "CenterLossOutputLayer",
     "GaussianReconstructionDistribution", "BernoulliReconstructionDistribution",
     "CompositeReconstructionDistribution", "LossFunctionWrapper",

@@ -71,21 +71,27 @@ class BatchNormalization(LayerConf):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but feature/channel axis
+        # Statistics in >= f32: bf16 accumulation over batch*spatial loses
+        # precision and running averages drift (f64 inputs keep f64 so the
+        # gradient-check harness stays exact).
+        cdt = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(cdt)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        xhat = (xf - mean) * lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xhat = xhat * params["gamma"] + params["beta"]
+            xhat = (xhat * params["gamma"].astype(cdt)
+                    + params["beta"].astype(cdt))
         else:
             xhat = xhat * self.gamma_init + self.beta_init
-        return self._act(xhat), new_state
+        return self._act(xhat).astype(x.dtype), new_state
 
 
 @register_layer

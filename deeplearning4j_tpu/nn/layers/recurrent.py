@@ -38,7 +38,7 @@ from ..conf.input_type import InputType
 from .feedforward import BaseOutputLayerConf
 
 __all__ = ["GravesLSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
-           "BaseRecurrentLayer"]
+           "BaseRecurrentLayer", "LastTimeStep"]
 
 
 @dataclass
@@ -231,3 +231,25 @@ class RnnOutputLayer(BaseOutputLayerConf):
         if self.has_bias:
             z = z + params["b"]
         return z
+
+
+@register_layer
+@dataclass
+class LastTimeStep(LayerConf):
+    """[B,T,F] -> [B,F]: the last (mask-aware) timestep. The capability the
+    reference reaches via `LastTimeStepVertex` (graph) — needed sequentially
+    for Keras `return_sequences=False` recurrent layers
+    (`modelimport/keras/layers/KerasLstm.java`)."""
+
+    input_kind = "rnn"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.size)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        # last step where mask == 1 (variable-length sequences)
+        idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+        return jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :], state

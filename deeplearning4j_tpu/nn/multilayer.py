@@ -30,7 +30,7 @@ import numpy as np
 
 from .conf import (BackpropType, MultiLayerConfiguration,
                    NeuralNetConfiguration, OptimizationAlgorithm)
-from .conf.base import LayerConf
+from .conf.base import LayerConf, cast_floating
 from .gradnorm import apply_gradient_normalization
 from .layers.feedforward import BaseOutputLayerConf
 from ..datasets.iterators import ArrayDataSetIterator, DataSet, DataSetIterator
@@ -64,6 +64,10 @@ class MultiLayerNetwork:
     # Initialization
     # ------------------------------------------------------------------
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        from . import activations as _acts
+        for layer in self.layers:
+            if layer.activation is not None:  # fail fast on bad names
+                _acts.get(layer.activation)
         seed = self.conf.conf.seed if seed is None else seed
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)
@@ -98,6 +102,14 @@ class MultiLayerNetwork:
     def _layer_updater(self, layer: LayerConf):
         return layer.updater or self.conf.conf.updater
 
+    @functools.cached_property
+    def _compute_dtype(self):
+        """jnp dtype for mixed-precision compute, or None when disabled."""
+        cdt = self.conf.conf.compute_dtype
+        if cdt is None or jnp.dtype(cdt) == jnp.dtype(self.conf.conf.dtype):
+            return None
+        return jnp.dtype(cdt)
+
     # ------------------------------------------------------------------
     # Pure functional core (closed over static layer configs)
     # ------------------------------------------------------------------
@@ -112,17 +124,26 @@ class MultiLayerNetwork:
         new_state = list(state)
         new_carries = list(carries) if carries is not None else [None] * len(self.layers)
         mask = fmask
+        cdt = self._compute_dtype
+        if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(cdt)
         for i in range(n):
             layer = self.layers[i]
+            p_i = params[i]
+            # Mixed precision: hidden layers compute in cdt (bf16 on the MXU);
+            # output layers stay in the master dtype so softmax/loss are f32
+            # (their matmul promotes bf16 activations back up).
+            if cdt is not None and not isinstance(layer, BaseOutputLayerConf):
+                p_i = cast_floating(p_i, cdt)
             if i in self.conf.preprocessors:
                 x = self.conf.preprocessors[i].apply(x)
                 mask = self.conf.preprocessors[i].apply_mask(mask)
             if carries is not None and getattr(layer, "is_recurrent", False):
                 (x, new_carries[i]), new_state[i] = layer.apply(
-                    params[i], state[i], x, train=train, rng=rngs[i],
+                    p_i, state[i], x, train=train, rng=rngs[i],
                     mask=mask, carry=carries[i], return_carry=True)
             else:
-                x, new_state[i] = layer.apply(params[i], state[i], x,
+                x, new_state[i] = layer.apply(p_i, state[i], x,
                                               train=train, rng=rngs[i],
                                               mask=mask)
         return x, tuple(new_state), mask, tuple(new_carries)
@@ -292,10 +313,7 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, ds: DataSet):
-        x = jnp.asarray(ds.features)
-        y = jnp.asarray(ds.labels)
-        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        x, y, fmask, lmask = ds.device_tuple()
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and x.ndim == 3):
             self._fit_tbptt(x, y, fmask, lmask)

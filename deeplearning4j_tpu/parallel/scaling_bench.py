@@ -1,0 +1,104 @@
+"""Data-parallel scaling-efficiency harness (BASELINE config #5).
+
+The capability analog of the reference's ParallelWrapper / Spark scaling
+story, measured the way its stats pipeline measures phases
+(`dl4j-spark/.../impl/paramavg/stats/ParameterAveragingTrainingMasterStats.java`):
+per-step wall time at fixed GLOBAL batch, 1 device vs N devices (strong
+scaling). On a real pod over ICI the ideal is t_n = t_1/N. On the virtual CPU
+mesh (`--xla_force_host_platform_device_count`) all "devices" share the same
+host cores, so total compute per step is constant and the ideal is t_n = t_1;
+efficiency = t_1/t_n then isolates framework + collective overhead (the thing
+the virtual mesh *can* measure — ICI bandwidth needs real chips).
+
+Run standalone:
+    python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8
+Prints one JSON line: {"t1_ms": ..., "tn_ms": ..., "devices": N,
+"efficiency": t1/tn}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _provision(n_devices: int) -> None:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # caller asked for the virtual CPU mesh (bench.py does)
+        from ..util.platform import provision_virtual_devices
+
+        ok = provision_virtual_devices(n_devices)
+    else:
+        import jax  # real accelerators: leave the platform alone
+
+        ok = len(jax.devices()) >= n_devices
+    if not ok:
+        import jax
+
+        raise SystemExit(
+            f"need {n_devices} devices, have {len(jax.devices())}; set "
+            "JAX_PLATFORMS=cpu + XLA_FLAGS=--xla_force_host_platform_"
+            "device_count before jax imports or run in a fresh process")
+
+
+def measure(n_devices: int, global_batch: int = 1024, steps: int = 20,
+            warmup: int = 3, hidden: int = 512):
+    """Avg step time (ms) for SYNC data-parallel training of an MLP with a
+    fixed `global_batch` sharded over an n-device mesh."""
+    import jax
+    import numpy as np
+
+    from ..datasets.iterators import DataSet
+    from ..nn.conf import InputType, NeuralNetConfiguration
+    from ..nn.layers import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..nn.updaters import Adam
+    from .mesh import make_mesh
+    from .trainer import ParallelTrainer, TrainingMode
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    mesh = make_mesh({"data": n_devices},
+                     devices=jax.devices()[:n_devices])
+    trainer = ParallelTrainer(model, mesh=mesh, mode=TrainingMode.SYNC)
+    batch = global_batch
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        trainer.fit(ds)
+    float(trainer.score())  # host materialization: real sync barrier
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.fit(ds)
+    float(trainer.score())
+    dt = (time.perf_counter() - t0) / steps
+    return dt * 1000.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--global-batch", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    a = ap.parse_args(argv)
+    _provision(a.devices)
+    t1 = measure(1, a.global_batch, a.steps)
+    tn = measure(a.devices, a.global_batch, a.steps)
+    print(json.dumps({"t1_ms": round(t1, 2), "tn_ms": round(tn, 2),
+                      "devices": a.devices,
+                      "efficiency": round(t1 / tn, 3)}))
+
+
+if __name__ == "__main__":
+    main()

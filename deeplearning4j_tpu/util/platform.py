@@ -1,0 +1,63 @@
+"""Virtual-device provisioning shared by the dryrun/bench/test harnesses.
+
+One home for the "N virtual CPU devices" recipe (the reference's analog is
+`local[N]` Spark in `BaseSparkTest.java:89`): XLA_FLAGS gets
+`--xla_force_host_platform_device_count=N` and the platform is forced to CPU.
+On this class of machine a sitecustomize pins JAX_PLATFORMS to a TPU plugin,
+and jax config beats env, so the in-process variant must call
+`jax.config.update("jax_platforms", "cpu")` BEFORE the first `jax.devices()`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+__all__ = ["child_env_with_virtual_devices", "provision_virtual_devices"]
+
+
+def _with_flag(flags: str, n_devices: int) -> str:
+    if "xla_force_host_platform_device_count" in flags:
+        return flags
+    return (flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+
+def child_env_with_virtual_devices(n_devices: int,
+                                   base: Optional[Dict[str, str]] = None
+                                   ) -> Dict[str, str]:
+    """A copy of the environment configured so a CHILD process sees
+    `n_devices` virtual CPU devices. Does not mutate os.environ."""
+    env = dict(os.environ if base is None else base)
+    env["XLA_FLAGS"] = _with_flag(env.get("XLA_FLAGS", ""), n_devices)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def provision_virtual_devices(n_devices: int) -> bool:
+    """Make THIS process see >= n_devices devices, forcing the virtual CPU
+    platform when needed. Returns True on success, False if the jax backend
+    was already initialized with too few devices (caller must re-exec with
+    `child_env_with_virtual_devices`). Restores os.environ afterwards — the
+    backend snapshots flags at initialization, so later subprocesses are not
+    silently pinned to CPU."""
+    old_flags = os.environ.get("XLA_FLAGS")
+    old_platforms = os.environ.get("JAX_PLATFORMS")
+    os.environ["XLA_FLAGS"] = _with_flag(old_flags or "", n_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        try:
+            # Config wins over a sitecustomize-pinned platform, but only
+            # before backend initialization.
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return len(jax.devices()) >= n_devices
+    finally:
+        for key, old in (("XLA_FLAGS", old_flags),
+                         ("JAX_PLATFORMS", old_platforms)):
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
