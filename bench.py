@@ -7,8 +7,9 @@ Covers all five BASELINE.md configs:
   2. ResNet-50 ImageNet samples/sec     (zoo.bench_resnet50, bf16 b256) - headline
   3. GravesLSTM char-RNN tokens/sec     (zoo.bench_char_rnn)
   4. Word2Vec skip-gram NS words/sec    (bench_word2vec, zipf corpus)
-  5. DP weak-scaling efficiency, 8-dev virtual mesh (parallel.scaling_bench,
-     subprocess so it can force the CPU platform)
+  5. DP strong-scaling overhead efficiency (fixed global batch), 8-dev
+     virtual mesh (parallel.scaling_bench, subprocess so it can force the
+     CPU platform)
 
 The reference publishes no numbers (BASELINE.json "published": {}), so
 vs_baseline is the ratio against round-1's first measured value
@@ -82,7 +83,7 @@ def main():
     try:
         sc = bench_scaling(8)
         if sc:
-            extras["DP-weak-scaling-8dev"] = sc["efficiency"]
+            extras["DP-strong-scaling-8dev"] = sc["efficiency"]
     except Exception:
         pass
 

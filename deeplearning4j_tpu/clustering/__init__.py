@@ -1,5 +1,6 @@
 from .kmeans import KMeansClustering
 from .kdtree import KDTree
 from .vptree import VPTree
+from .sptree import QuadTree, SpTree
 
-__all__ = ["KMeansClustering", "KDTree", "VPTree"]
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "QuadTree", "SpTree"]

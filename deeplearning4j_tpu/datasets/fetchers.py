@@ -1,0 +1,255 @@
+"""Benchmark dataset fetchers: MNIST (IDX binary), Iris (embedded), CIFAR-10
+(binary batches).
+
+Reference parity:
+  * MNIST — `deeplearning4j-core/.../datasets/fetchers/MnistDataFetcher.java:40`
+    + the IDX readers under `datasets/mnist/` and the download helper
+    `base/MnistFetcher.java` (download + local cache + binary parse).
+  * Iris — `datasets/fetchers/IrisDataFetcher.java` (the reference ships the
+    150 rows as a resource; here they're embedded).
+  * CIFAR-10 — `datasets/iterator/impl/CifarDataSetIterator.java:17` (binary
+    "data_batch_N.bin" records: 1 label byte + 3072 channel-major bytes).
+
+Cache layout: $DL4J_TPU_DATA_DIR (default ~/.deeplearning4j_tpu) /<dataset>/.
+Downloads only happen when the cache misses; offline environments can drop
+pre-fetched files in the cache dir (tests synthesize IDX/CIFAR files this way).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+import urllib.request
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "data_dir", "read_idx", "MnistDataFetcher", "IrisDataFetcher",
+    "CifarDataFetcher", "IRIS_FEATURES", "IRIS_LABELS",
+]
+
+_MNIST_URLS = [
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+]
+_MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte.gz",
+    "train_labels": "train-labels-idx1-ubyte.gz",
+    "test_images": "t10k-images-idx3-ubyte.gz",
+    "test_labels": "t10k-labels-idx1-ubyte.gz",
+}
+_CIFAR_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+
+
+def data_dir(dataset: str = "") -> str:
+    root = os.environ.get("DL4J_TPU_DATA_DIR",
+                          os.path.expanduser("~/.deeplearning4j_tpu"))
+    path = os.path.join(root, dataset) if dataset else root
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _download(url: str, dest: str, timeout: int = 60) -> bool:
+    tmp = dest + ".part"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, dest)
+        return True
+    except Exception:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (optionally .gz): magic = 0x00 0x00 <dtype> <ndim>.
+    MNIST uses dtype 0x08 (ubyte) with ndim 1 (labels) or 3 (images)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zero, dtype_code, ndim = struct.unpack(">HBB", data[:4])
+    if zero != 0:
+        raise ValueError(f"{path}: bad IDX magic {data[:4]!r}")
+    dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: ">i2", 0x0C: ">i4",
+              0x0D: ">f4", 0x0E: ">f8"}
+    if dtype_code not in dtypes:
+        raise ValueError(f"{path}: unknown IDX dtype 0x{dtype_code:02x}")
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    arr = np.frombuffer(data, dtype=dtypes[dtype_code], offset=4 + 4 * ndim)
+    if arr.size != int(np.prod(dims)):
+        raise ValueError(f"{path}: payload size {arr.size} != shape {dims}")
+    return arr.reshape(dims)
+
+
+class MnistDataFetcher:
+    """70k 28x28 grayscale digits. `fetch(train)` -> (images [N,784] float32
+    in [0,1] (or binarized), labels one-hot [N,10])."""
+
+    NUM_EXAMPLES = 60000
+    NUM_EXAMPLES_TEST = 10000
+
+    def __init__(self, binarize: bool = False, train: bool = True,
+                 shuffle: bool = False, seed: Optional[int] = None,
+                 cache: Optional[str] = None):
+        self.binarize = binarize
+        self.train = train
+        self.shuffle = shuffle
+        self.seed = seed
+        self.cache = cache or data_dir("mnist")
+
+    def _file(self, key: str) -> str:
+        fname = _MNIST_FILES[key]
+        dest = os.path.join(self.cache, fname)
+        raw = dest[:-3]  # pre-extracted variant also accepted
+        if os.path.exists(dest) or os.path.exists(raw):
+            return dest if os.path.exists(dest) else raw
+        for base in _MNIST_URLS:
+            if _download(base + fname, dest):
+                return dest
+        raise FileNotFoundError(
+            f"MNIST file {fname} not in cache {self.cache} and download "
+            "failed (offline?). Place the IDX .gz files there manually.")
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        prefix = "train" if self.train else "test"
+        images = read_idx(self._file(f"{prefix}_images"))
+        labels = read_idx(self._file(f"{prefix}_labels"))
+        x = images.reshape(images.shape[0], -1).astype(np.float32) / 255.0
+        if self.binarize:
+            x = (x > 0.5).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[labels.astype(np.int64)]
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed)
+            idx = rng.permutation(x.shape[0])
+            x, y = x[idx], y[idx]
+        return x, y
+
+
+# The classic Fisher/Anderson Iris data (150 rows, public domain), embedded
+# the way the reference ships it as a bundled resource.
+_IRIS_ROWS = """
+5.1,3.5,1.4,0.2,0;4.9,3.0,1.4,0.2,0;4.7,3.2,1.3,0.2,0;4.6,3.1,1.5,0.2,0;
+5.0,3.6,1.4,0.2,0;5.4,3.9,1.7,0.4,0;4.6,3.4,1.4,0.3,0;5.0,3.4,1.5,0.2,0;
+4.4,2.9,1.4,0.2,0;4.9,3.1,1.5,0.1,0;5.4,3.7,1.5,0.2,0;4.8,3.4,1.6,0.2,0;
+4.8,3.0,1.4,0.1,0;4.3,3.0,1.1,0.1,0;5.8,4.0,1.2,0.2,0;5.7,4.4,1.5,0.4,0;
+5.4,3.9,1.3,0.4,0;5.1,3.5,1.4,0.3,0;5.7,3.8,1.7,0.3,0;5.1,3.8,1.5,0.3,0;
+5.4,3.4,1.7,0.2,0;5.1,3.7,1.5,0.4,0;4.6,3.6,1.0,0.2,0;5.1,3.3,1.7,0.5,0;
+4.8,3.4,1.9,0.2,0;5.0,3.0,1.6,0.2,0;5.0,3.4,1.6,0.4,0;5.2,3.5,1.5,0.2,0;
+5.2,3.4,1.4,0.2,0;4.7,3.2,1.6,0.2,0;4.8,3.1,1.6,0.2,0;5.4,3.4,1.5,0.4,0;
+5.2,4.1,1.5,0.1,0;5.5,4.2,1.4,0.2,0;4.9,3.1,1.5,0.2,0;5.0,3.2,1.2,0.2,0;
+5.5,3.5,1.3,0.2,0;4.9,3.6,1.4,0.1,0;4.4,3.0,1.3,0.2,0;5.1,3.4,1.5,0.2,0;
+5.0,3.5,1.3,0.3,0;4.5,2.3,1.3,0.3,0;4.4,3.2,1.3,0.2,0;5.0,3.5,1.6,0.6,0;
+5.1,3.8,1.9,0.4,0;4.8,3.0,1.4,0.3,0;5.1,3.8,1.6,0.2,0;4.6,3.2,1.4,0.2,0;
+5.3,3.7,1.5,0.2,0;5.0,3.3,1.4,0.2,0;7.0,3.2,4.7,1.4,1;6.4,3.2,4.5,1.5,1;
+6.9,3.1,4.9,1.5,1;5.5,2.3,4.0,1.3,1;6.5,2.8,4.6,1.5,1;5.7,2.8,4.5,1.3,1;
+6.3,3.3,4.7,1.6,1;4.9,2.4,3.3,1.0,1;6.6,2.9,4.6,1.3,1;5.2,2.7,3.9,1.4,1;
+5.0,2.0,3.5,1.0,1;5.9,3.0,4.2,1.5,1;6.0,2.2,4.0,1.0,1;6.1,2.9,4.7,1.4,1;
+5.6,2.9,3.6,1.3,1;6.7,3.1,4.4,1.4,1;5.6,3.0,4.5,1.5,1;5.8,2.7,4.1,1.0,1;
+6.2,2.2,4.5,1.5,1;5.6,2.5,3.9,1.1,1;5.9,3.2,4.8,1.8,1;6.1,2.8,4.0,1.3,1;
+6.3,2.5,4.9,1.5,1;6.1,2.8,4.7,1.2,1;6.4,2.9,4.3,1.3,1;6.6,3.0,4.4,1.4,1;
+6.8,2.8,4.8,1.4,1;6.7,3.0,5.0,1.7,1;6.0,2.9,4.5,1.5,1;5.7,2.6,3.5,1.0,1;
+5.5,2.4,3.8,1.1,1;5.5,2.4,3.7,1.0,1;5.8,2.7,3.9,1.2,1;6.0,2.7,5.1,1.6,1;
+5.4,3.0,4.5,1.5,1;6.0,3.4,4.5,1.6,1;6.7,3.1,4.7,1.5,1;6.3,2.3,4.4,1.3,1;
+5.6,3.0,4.1,1.3,1;5.5,2.5,4.0,1.3,1;5.5,2.6,4.4,1.2,1;6.1,3.0,4.6,1.4,1;
+5.8,2.6,4.0,1.2,1;5.0,2.3,3.3,1.0,1;5.6,2.7,4.2,1.3,1;5.7,3.0,4.2,1.2,1;
+5.7,2.9,4.2,1.3,1;6.2,2.9,4.3,1.3,1;5.1,2.5,3.0,1.1,1;5.7,2.8,4.1,1.3,1;
+6.3,3.3,6.0,2.5,2;5.8,2.7,5.1,1.9,2;7.1,3.0,5.9,2.1,2;6.3,2.9,5.6,1.8,2;
+6.5,3.0,5.8,2.2,2;7.6,3.0,6.6,2.1,2;4.9,2.5,4.5,1.7,2;7.3,2.9,6.3,1.8,2;
+6.7,2.5,5.8,1.8,2;7.2,3.6,6.1,2.5,2;6.5,3.2,5.1,2.0,2;6.4,2.7,5.3,1.9,2;
+6.8,3.0,5.5,2.1,2;5.7,2.5,5.0,2.0,2;5.8,2.8,5.1,2.4,2;6.4,3.2,5.3,2.3,2;
+6.5,3.0,5.5,1.8,2;7.7,3.8,6.7,2.2,2;7.7,2.6,6.9,2.3,2;6.0,2.2,5.0,1.5,2;
+6.9,3.2,5.7,2.3,2;5.6,2.8,4.9,2.0,2;7.7,2.8,6.7,2.0,2;6.3,2.7,4.9,1.8,2;
+6.7,3.3,5.7,2.1,2;7.2,3.2,6.0,1.8,2;6.2,2.8,4.8,1.8,2;6.1,3.0,4.9,1.8,2;
+6.4,2.8,5.6,2.1,2;7.2,3.0,5.8,1.6,2;7.4,2.8,6.1,1.9,2;7.9,3.8,6.4,2.0,2;
+6.4,2.8,5.6,2.2,2;6.3,2.8,5.1,1.5,2;6.1,2.6,5.6,1.4,2;7.7,3.0,6.1,2.3,2;
+6.3,3.4,5.6,2.4,2;6.4,3.1,5.5,1.8,2;6.0,3.0,4.8,1.8,2;6.9,3.1,5.4,2.1,2;
+6.7,3.1,5.6,2.4,2;6.9,3.1,5.1,2.3,2;5.8,2.7,5.1,1.9,2;6.8,3.2,5.9,2.3,2;
+6.7,3.3,5.7,2.5,2;6.7,3.0,5.2,2.3,2;6.3,2.5,5.0,1.9,2;6.5,3.0,5.2,2.0,2;
+6.2,3.4,5.4,2.3,2;5.9,3.0,5.1,1.8,2
+""".replace("\n", "")
+
+_iris = np.array([[float(v) for v in row.split(",")]
+                  for row in _IRIS_ROWS.strip(";").split(";")],
+                 dtype=np.float32)
+IRIS_FEATURES: np.ndarray = _iris[:, :4]
+IRIS_LABELS: np.ndarray = np.eye(3, dtype=np.float32)[
+    _iris[:, 4].astype(np.int64)]
+
+
+class IrisDataFetcher:
+    NUM_EXAMPLES = 150
+
+    def __init__(self, shuffle: bool = False, seed: Optional[int] = None,
+                 normalize: bool = True):
+        self.shuffle = shuffle
+        self.seed = seed
+        self.normalize = normalize
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        x, y = IRIS_FEATURES.copy(), IRIS_LABELS.copy()
+        if self.normalize:
+            x = (x - x.mean(axis=0)) / x.std(axis=0)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed)
+            idx = rng.permutation(x.shape[0])
+            x, y = x[idx], y[idx]
+        return x, y
+
+
+class CifarDataFetcher:
+    """CIFAR-10 binary format: records of 1 label byte + 32*32*3 bytes in
+    channel-major (R plane, G plane, B plane) order; returned NHWC float32
+    in [0,1], labels one-hot [N,10]."""
+
+    NUM_TRAIN = 50000
+    NUM_TEST = 10000
+
+    def __init__(self, train: bool = True, cache: Optional[str] = None):
+        self.train = train
+        self.cache = cache or data_dir("cifar10")
+
+    def _batch_files(self) -> List[str]:
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)]
+                 if self.train else ["test_batch.bin"])
+        found = []
+        for name in names:
+            for cand in (os.path.join(self.cache, name),
+                         os.path.join(self.cache, "cifar-10-batches-bin",
+                                      name)):
+                if os.path.exists(cand):
+                    found.append(cand)
+                    break
+        if len(found) == len(names):
+            return found
+        # cache miss: download + extract the official tarball
+        tarball = os.path.join(self.cache, "cifar-10-binary.tar.gz")
+        if not os.path.exists(tarball):
+            if not _download(_CIFAR_URL, tarball, timeout=300):
+                raise FileNotFoundError(
+                    f"CIFAR-10 batches not in cache {self.cache} and "
+                    "download failed (offline?). Place data_batch_*.bin / "
+                    "test_batch.bin there manually.")
+        with tarfile.open(tarball, "r:gz") as tf:
+            tf.extractall(self.cache, filter="data")
+        return self._batch_files()
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        xs, ys = [], []
+        for path in self._batch_files():
+            raw = np.frombuffer(open(path, "rb").read(), dtype=np.uint8)
+            rec = raw.reshape(-1, 3073)
+            ys.append(rec[:, 0])
+            xs.append(rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        y = np.eye(10, dtype=np.float32)[np.concatenate(ys).astype(np.int64)]
+        return x, y

@@ -1,0 +1,23 @@
+"""Keras model import (L8 interop).
+
+Capability parity with `deeplearning4j-modelimport` — the reference's
+flagship interop: HDF5 → network configuration + weights
+(`keras/KerasModelImport.java`, `KerasModel.java:59`,
+`KerasSequentialModel.java`, `Hdf5Archive.java:46`, 14 `layers/Keras*.java`
+mappers). TPU-native: h5py instead of the JavaCPP HDF5 bridge, our NHWC
+layout means TF `channels_last` weights import without the dim-order
+gymnastics of `TensorFlowCnnToFeedForwardPreProcessor.java`.
+"""
+from .hdf5 import Hdf5Archive
+from .keras import (KerasImportError, import_keras_model_and_weights,
+                    import_keras_model_configuration,
+                    import_keras_sequential_configuration,
+                    import_keras_sequential_model_and_weights)
+
+__all__ = [
+    "Hdf5Archive", "KerasImportError",
+    "import_keras_model_and_weights",
+    "import_keras_sequential_model_and_weights",
+    "import_keras_model_configuration",
+    "import_keras_sequential_configuration",
+]

@@ -80,8 +80,6 @@ def char_rnn(vocab_size: int = 77, lstm_size: int = 200, seq_len: int = 64,
 def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 20,
                    warmup: int = 3, vocab: int = 77):
     """tokens/sec for char-RNN training (BASELINE config #3)."""
-    import jax
-
     from ..datasets.iterators import DataSet
 
     model = char_rnn(vocab_size=vocab, seq_len=seq_len).init()
@@ -177,8 +175,6 @@ def bench_resnet50(batch: int = 256, steps: int = 20, warmup: int = 3,
     """samples/sec for ResNet-50 ImageNet-shaped training (BASELINE #2).
     Inputs are device-resident (DataSet.device_tuple cache) so the number
     measures the training step, not the host link."""
-    import jax
-
     from ..datasets.iterators import DataSet
 
     model = resnet50(image=image, n_classes=n_classes,
@@ -229,8 +225,6 @@ def vgg16(n_classes: int = 1000, image: int = 224, seed: int = 42,
 
 def bench_lenet(batch: int = 512, steps: int = 40, warmup: int = 5):
     """samples/sec for LeNet-MNIST training steps (BASELINE config #1)."""
-    import jax
-
     from ..datasets.iterators import DataSet
 
     model = lenet_mnist().init()

@@ -205,6 +205,13 @@ class LayerConf:
               mask=None):
         raise NotImplementedError(type(self).__name__)
 
+    def output_mask(self, mask):
+        """Mask transform for this layer's output (reference
+        `feedForwardMaskArray`). Layers that collapse the time axis
+        ([B,T,F] -> [B,F]) must return None so downstream losses don't
+        broadcast a [B,T] mask against per-example values."""
+        return mask
+
     # ---- regularization contribution ------------------------------------
     def reg_score(self, params) -> jax.Array:
         """L1/L2 penalty for this layer's params (weights vs biases split, as

@@ -152,7 +152,7 @@ class ComputationGraph:
                 y, new_state[name] = v.apply(p_v, state[name], x,
                                              train=train, rng=rngs[i], mask=m)
                 values[name] = y
-                masks[name] = m
+                masks[name] = v.output_mask(m)
             else:
                 values[name] = v.apply(ins, in_masks)
                 masks[name] = v.output_mask(in_masks)
@@ -296,9 +296,30 @@ class ComputationGraph:
             self.epoch_count += 1
         return self
 
+    @_functools.cached_property
+    def _line_solver(self):
+        from ..optimize.solvers import GraphLineSearchSolver
+        return GraphLineSearchSolver(
+            self, self.conf.conf.optimization_algo,
+            max_line_search_iterations=
+            self.conf.conf.max_num_line_search_iterations)
+
     def _fit_batch(self, ds):
+        from .conf import OptimizationAlgorithm as OA
+
         inputs, labels, fmasks, lmasks = self._to_inputs(ds)
         self._rng, step_rng = jax.random.split(self._rng)
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            self.params, self.state, score = self._line_solver.fit_batch(
+                self.params, self.state, inputs, labels, step_rng, fmasks,
+                lmasks)
+            self._score = score
+            self.last_batch_size = int(
+                next(iter(inputs.values())).shape[0])
+            self.iteration_count += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+            return
         step = jnp.asarray(self.iteration_count, jnp.int32)
         (self.params, self.state, self.updater_state,
          score) = self._train_step(self.params, self.state,

@@ -36,6 +36,9 @@ class GlobalPoolingLayer(LayerConf):
             return InputType.feed_forward(it.channels)
         return it
 
+    def output_mask(self, mask):
+        return None  # pooled axes collapsed: per-step mask no longer applies
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         if x.ndim == 3:       # [B, T, F] over time
             axes = (1,)

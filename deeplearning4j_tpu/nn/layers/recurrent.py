@@ -253,3 +253,6 @@ class LastTimeStep(LayerConf):
         idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
         return jnp.take_along_axis(
             x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :], state
+
+    def output_mask(self, mask):
+        return None  # time axis collapsed: [B,T] mask no longer applies

@@ -146,6 +146,7 @@ class MultiLayerNetwork:
                 x, new_state[i] = layer.apply(p_i, state[i], x,
                                               train=train, rng=rngs[i],
                                               mask=mask)
+            mask = layer.output_mask(mask)
         return x, tuple(new_state), mask, tuple(new_carries)
 
     def _reg_score(self, params):
@@ -312,17 +313,34 @@ class MultiLayerNetwork:
             self.epoch_count += 1
         return self
 
+    @functools.cached_property
+    def _line_solver(self):
+        from ..optimize.solvers import LineSearchSolver
+        return LineSearchSolver(
+            self, self.conf.conf.optimization_algo,
+            max_line_search_iterations=
+            self.conf.conf.max_num_line_search_iterations)
+
     def _fit_batch(self, ds: DataSet):
+        from .conf import OptimizationAlgorithm as OA
+
         x, y, fmask, lmask = ds.device_tuple()
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and x.ndim == 3):
             self._fit_tbptt(x, y, fmask, lmask)
             return
         self._rng, step_rng = jax.random.split(self._rng)
-        step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
-        self.params, self.state, self.updater_state, score = self._train_step(
-            self.params, self.state, self.updater_state, step, x, y,
-            step_rng, fmask, lmask)
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            # line-search path (Solver.java -> CG/LBFGS/line GD); the
+            # updater chain is SGD-only, as in the reference's BaseOptimizer
+            self.params, self.state, score = self._line_solver.fit_batch(
+                self.params, self.state, x, y, step_rng, fmask, lmask)
+        else:
+            step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
+            (self.params, self.state, self.updater_state,
+             score) = self._train_step(
+                self.params, self.state, self.updater_state, step, x, y,
+                step_rng, fmask, lmask)
         self._score = score
         self.last_batch_size = int(x.shape[0])
         self.iteration_count += 1

@@ -133,9 +133,114 @@ class Tsne:
                 f.write(f"{coords},{label}\n")
 
 
+def _sparse_p(x: np.ndarray, perplexity: float, iters: int = 50):
+    """kNN-sparse, symmetrized input similarities (the reference builds the
+    same via VPTree + per-row beta search, `BarnesHutTsne.java:64`).
+    Returns (rows, cols, values) of P_sym with sum(values) == 1."""
+    from ..clustering.vptree import VPTree
+
+    n = x.shape[0]
+    k = min(n - 1, int(3 * perplexity))
+    tree = VPTree(x)
+    rows = np.empty(n * k, dtype=np.int64)
+    cols = np.empty(n * k, dtype=np.int64)
+    vals = np.empty(n * k, dtype=np.float64)
+    target = np.log(perplexity)
+    for i in range(n):
+        nbrs = tree.knn(x[i], k + 1)  # includes self at distance 0
+        nbrs = [(d, j) for d, j in nbrs if j != i][:k]
+        d2 = np.array([d * d for d, _ in nbrs])
+        beta, lo, hi = 1.0, 0.0, np.inf
+        p = np.exp(-d2 * beta)
+        for _ in range(iters):
+            sum_p = max(p.sum(), 1e-12)
+            h = np.log(sum_p) + beta * float((d2 * p).sum()) / sum_p
+            if abs(h - target) < 1e-5:
+                break
+            if h > target:
+                lo = beta
+                beta = beta * 2.0 if np.isinf(hi) else (beta + hi) / 2.0
+            else:
+                hi = beta
+                beta = beta / 2.0 if lo <= 0 else (beta + lo) / 2.0
+            p = np.exp(-d2 * beta)
+        p = p / max(p.sum(), 1e-12)
+        sl = slice(i * k, (i + 1) * k)
+        rows[sl] = i
+        cols[sl] = [j for _, j in nbrs]
+        vals[sl] = p
+    # symmetrize: P = (P + P^T) / (2N) over the union of edges
+    edge = {}
+    for r, c, v in zip(rows, cols, vals):
+        edge[(r, c)] = edge.get((r, c), 0.0) + v
+        edge[(c, r)] = edge.get((c, r), 0.0) + v
+    r_out = np.array([rc[0] for rc in edge], dtype=np.int64)
+    c_out = np.array([rc[1] for rc in edge], dtype=np.int64)
+    v_out = np.array(list(edge.values()), dtype=np.float64) / (2.0 * n)
+    v_out = np.maximum(v_out / v_out.sum(), 1e-12)
+    return r_out, c_out, v_out
+
+
 class BarnesHutTsne(Tsne):
-    """Reference API name. Implements the `Model`-like surface the reference
-    exposes (fit / getData)."""
+    """O(N log N) Barnes-Hut t-SNE (`plot/BarnesHutTsne.java:64`): kNN-sparse
+    input similarities from a VPTree, attractive forces over the sparse
+    edges, repulsive forces via SpTree traversal with the `theta` criterion
+    (theta=0 degenerates to exact). Host/NumPy — a visualization tool, same
+    placement as the reference's CPU implementation."""
+
+    def fit_transform(self, x) -> np.ndarray:
+        from ..clustering.sptree import SpTree
+
+        x = np.asarray(x, dtype=np.float64)
+        n = x.shape[0]
+        rows, cols, p_vals = _sparse_p(x, self.perplexity)
+        rng = np.random.default_rng(self.seed)
+        y = 1e-4 * rng.normal(size=(n, self.n_components))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+
+        for it in range(self.max_iter):
+            exag = (self.exaggeration if it < self.stop_lying_iteration
+                    else 1.0)
+            momentum = (self.momentum if it < self.switch_momentum_iteration
+                        else self.final_momentum)
+            # attractive forces over sparse edges: p_ij q_ij (y_i - y_j)
+            diff = y[rows] - y[cols]
+            q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            coef = (exag * p_vals * q)[:, None] * diff
+            attr = np.zeros_like(y)
+            np.add.at(attr, rows, coef)
+            # repulsive forces via the space-partitioning tree
+            tree = SpTree(y)
+            rep = np.empty_like(y)
+            sum_q = 0.0
+            for i in range(n):
+                neg, sq = tree.compute_non_edge_forces(i, self.theta)
+                rep[i] = neg
+                sum_q += sq
+            grad = attr - rep / max(sum_q, 1e-12)
+            gains = np.where(np.sign(grad) != np.sign(vel),
+                             gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y = y - y.mean(axis=0)
+
+        self.y = np.asarray(y, dtype=np.float32)
+        # KL over the sparse edges (approximate, like the reference reports),
+        # normalized by a tree built on the FINAL embedding so the number is
+        # consistent with the returned y (and defined even for max_iter=0)
+        final_tree = SpTree(y)
+        sum_q = sum(final_tree.compute_non_edge_forces(i, self.theta)[1]
+                    for i in range(n))
+        diff = y[rows] - y[cols]
+        qn = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        q_norm = np.maximum(qn / max(sum_q, 1e-12), 1e-12)
+        self.kl_divergence = float(
+            np.sum(p_vals * np.log(p_vals / q_norm)))
+        return self.y
+
+    fit = fit_transform
 
     def get_data(self) -> np.ndarray:
         return self.y

@@ -1,0 +1,23 @@
+"""Observability stack (L9).
+
+Capability parity with the reference's stats pipeline
+(`ui/stats/BaseStatsListener.java:43` → SBE-encoded `StatsReport` →
+`StatsStorage` (`deeplearning4j-core/.../api/storage/StatsStorage.java`) →
+Play web UI (`PlayUIServer.java:53`, `module/train/TrainModule.java:53`)).
+
+TPU-native shape: the listener snapshots score/param/update statistics from
+the pytree between jitted steps (one device→host sync per report), reports are
+plain dicts serialized as JSON-lines (replacing the SBE binary codec), storage
+is pluggable (in-memory / file), and the dashboard is a dependency-free
+stdlib http.server rendering overview/model/system pages.
+"""
+from .stats import StatsListener, StatsReport
+from .storage import (FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+                      StatsStorageEvent, StatsStorageListener)
+from .server import UIServer
+
+__all__ = [
+    "StatsListener", "StatsReport", "StatsStorage", "InMemoryStatsStorage",
+    "FileStatsStorage", "StatsStorageEvent", "StatsStorageListener",
+    "UIServer",
+]

@@ -1,0 +1,208 @@
+"""UIServer: training dashboard over a StatsStorage.
+
+Parity with `ui/play/PlayUIServer.java:53` + `ui/api/UIServer.java:14`
+(singleton `get_instance()`, `attach(storage)`) and the TrainModule pages
+(overview / model / system, `module/train/TrainModule.java:53`). The
+reference embeds a Play server with Scala views + TS charts; here it's a
+dependency-free stdlib ThreadingHTTPServer serving one HTML page that polls
+JSON endpoints and renders inline-SVG charts (works offline, no CDN).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .storage import StatsStorage
+
+__all__ = ["UIServer"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:18px} h2{font-size:15px;margin:18px 0 6px}
+.tab{display:inline-block;margin-right:12px;cursor:pointer;color:#06c}
+.tab.active{font-weight:bold;color:#000}
+table{border-collapse:collapse;font-size:12px}
+td,th{border:1px solid #ccc;padding:3px 8px;text-align:right}
+th{background:#eee} svg{background:#fff;border:1px solid #ddd}
+#meta{color:#666;font-size:12px}
+</style></head><body>
+<h1>deeplearning4j_tpu &mdash; training</h1>
+<div id="meta"></div>
+<div><span class="tab active" data-p="overview">Overview</span>
+<span class="tab" data-p="model">Model</span>
+<span class="tab" data-p="system">System</span></div>
+<div id="content"></div>
+<script>
+let page='overview';
+document.querySelectorAll('.tab').forEach(t=>t.onclick=()=>{
+  document.querySelectorAll('.tab').forEach(x=>x.classList.remove('active'));
+  t.classList.add('active'); page=t.dataset.p; refresh();});
+function line(xs,ys,w,h,color){
+  if(ys.length<2) return '<svg width="'+w+'" height="'+h+'"></svg>';
+  const mn=Math.min(...ys), mx=Math.max(...ys), sp=(mx-mn)||1;
+  const pts=ys.map((y,i)=>((i/(ys.length-1))*(w-20)+10)+','+
+    (h-10-((y-mn)/sp)*(h-20))).join(' ');
+  return '<svg width="'+w+'" height="'+h+'"><polyline fill="none" stroke="'+
+    color+'" stroke-width="1.5" points="'+pts+'"/>'+
+    '<text x="4" y="12" font-size="10">'+mx.toPrecision(4)+'</text>'+
+    '<text x="4" y="'+(h-2)+'" font-size="10">'+mn.toPrecision(4)+'</text></svg>';
+}
+async function refresh(){
+  const d=await (await fetch('/train/data.json')).json();
+  document.getElementById('meta').textContent=
+    'session '+d.session+' · '+d.iterations.length+' reports · last score '+
+    (d.scores.at(-1)??'-');
+  let html='';
+  if(page=='overview'){
+    html+='<h2>Score vs iteration</h2>'+line(d.iterations,d.scores,640,220,'#c33');
+    if(d.samples_per_sec.length)
+      html+='<h2>samples/sec</h2>'+line(d.iterations,d.samples_per_sec,640,140,'#36c');
+  } else if(page=='model'){
+    html+='<h2>Parameters (latest)</h2><table><tr><th>param</th><th>mean</th>'+
+      '<th>stdev</th><th>min</th><th>max</th><th>update stdev</th></tr>';
+    for(const [k,v] of Object.entries(d.params))
+      html+='<tr><td style="text-align:left">'+k+'</td><td>'+v.mean.toPrecision(4)+
+        '</td><td>'+v.stdev.toPrecision(4)+'</td><td>'+v.min.toPrecision(4)+
+        '</td><td>'+v.max.toPrecision(4)+'</td><td>'+
+        (d.updates[k]?d.updates[k].stdev.toPrecision(4):'-')+'</td></tr>';
+    html+='</table>';
+    html+='<h2>Mean parameter stdev vs iteration</h2>'+
+      line(d.iterations,d.param_stdev,640,140,'#393');
+  } else {
+    html+='<h2>Host RSS (MB)</h2>'+line(d.iterations,d.rss_mb,640,140,'#939');
+  }
+  document.getElementById('content').innerHTML=html;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        ui: "UIServer" = self.server.ui  # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        if url.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/train/sessions.json":
+            self._json(ui.sessions())
+            return
+        if url.path == "/train/data.json":
+            q = parse_qs(url.query)
+            session = q.get("session", [None])[0]
+            self._json(ui.train_data(session))
+            return
+        self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """Singleton dashboard server (`UIServer.getInstance()` in the
+    reference). attach() storages; start() binds the port."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        if storage not in self._storages:
+            self._storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage) -> "UIServer":
+        if storage in self._storages:
+            self._storages.remove(storage)
+        return self
+
+    # -- data assembly (TrainModule's JSON endpoints) --------------------
+    def sessions(self) -> List[str]:
+        out = []
+        for st in self._storages:
+            out.extend(st.list_session_ids())
+        return out
+
+    def _updates(self, session: Optional[str]):
+        for st in self._storages:
+            sessions = st.list_session_ids()
+            if not sessions:
+                continue
+            sid = session if session in sessions else sessions[-1]
+            for typ in st.list_type_ids(sid):
+                for worker in st.list_worker_ids(sid, typ):
+                    return sid, st.get_all_updates(sid, typ, worker)
+        return None, []
+
+    def train_data(self, session: Optional[str] = None) -> dict:
+        sid, updates = self._updates(session)
+        reports = [r for _, r in updates]
+        latest = reports[-1] if reports else {}
+        import numpy as np
+
+        param_stdev = []
+        for r in reports:
+            ps = r.get("params") or {}
+            param_stdev.append(
+                float(np.mean([v["stdev"] for v in ps.values()]))
+                if ps else 0.0)
+        return {
+            "session": sid,
+            "iterations": [r.get("iteration", i)
+                           for i, r in enumerate(reports)],
+            "scores": [r.get("score") for r in reports],
+            "samples_per_sec": [r["perf"]["samples_per_sec"]
+                                for r in reports if "perf" in r],
+            "rss_mb": [r.get("memory", {}).get("rss_mb", 0) for r in reports],
+            "param_stdev": param_stdev,
+            "params": latest.get("params", {}),
+            "updates": latest.get("updates", {}),
+        }
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "UIServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._httpd.ui = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="dl4jtpu-ui")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

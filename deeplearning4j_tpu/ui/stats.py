@@ -1,0 +1,129 @@
+"""StatsListener: per-iteration training statistics.
+
+Parity with `ui/stats/BaseStatsListener.java:43` — collects score,
+per-parameter summary stats + histograms, update(-magnitude) stats, memory
+and throughput each `frequency` iterations, and routes a `StatsReport` into a
+`StatsStorage`. One device→host sync per report (the reference pays the same
+via INDArray host reads); set frequency>1 to amortize.
+"""
+from __future__ import annotations
+
+import resource
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .storage import StatsStorage
+from ..optimize.listeners import TrainingListener
+
+__all__ = ["StatsListener", "StatsReport"]
+
+
+def _flatten_params(model) -> Dict[str, np.ndarray]:
+    """{"layername/param": host array} for MultiLayerNetwork (tuple of dicts)
+    or ComputationGraph (dict of dicts)."""
+    out: Dict[str, np.ndarray] = {}
+    params = model.params
+    if params is None:
+        return out
+    if isinstance(params, dict):
+        items = params.items()
+    else:
+        names = [getattr(l, "name", None) or f"layer{i}"
+                 for i, l in enumerate(model.layers)]
+        items = zip(names, params)
+    for name, p in items:
+        if not p:
+            continue
+        for k, v in sorted(p.items()):
+            out[f"{name}/{k}"] = np.asarray(v)
+    return out
+
+
+def _summary(arr: np.ndarray, bins: int) -> Dict:
+    flat = arr.ravel().astype(np.float64)
+    counts, edges = np.histogram(flat, bins=bins)
+    return {
+        "mean": float(flat.mean()),
+        "stdev": float(flat.std()),
+        "min": float(flat.min()),
+        "max": float(flat.max()),
+        "histogram": {"counts": counts.tolist(),
+                      "min": float(edges[0]), "max": float(edges[-1])},
+    }
+
+
+class StatsReport(dict):
+    """A plain-dict report (JSON-able). Keys: iteration, timestamp, score,
+    params {name: summary}, updates {name: summary}, memory, perf."""
+
+
+class StatsListener(TrainingListener):
+    TYPE_ID = "StatsListener"
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "local",
+                 collect_histograms: bool = True, histogram_bins: int = 20,
+                 collect_updates: bool = True):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = int(histogram_bins)
+        self.collect_updates = collect_updates
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._last_time: Optional[float] = None
+        self._last_iter: Optional[int] = None
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency != 0:
+            return
+        now = time.time()
+        report = StatsReport(iteration=int(iteration), timestamp=now,
+                             score=float(model.score()))
+
+        params = _flatten_params(model)
+        if self.collect_histograms:
+            report["params"] = {k: _summary(v, self.histogram_bins)
+                                for k, v in params.items()}
+        if self.collect_updates and self._prev_params is not None:
+            upd = {}
+            for k, v in params.items():
+                prev = self._prev_params.get(k)
+                if prev is not None and prev.shape == v.shape:
+                    upd[k] = _summary(v - prev, self.histogram_bins)
+            report["updates"] = upd
+        self._prev_params = params if self.collect_updates else None
+
+        # memory (reference samples JVM/GC; here RSS + device stats if any)
+        mem = {"rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024.0}
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                mem["device_bytes_in_use"] = int(
+                    stats.get("bytes_in_use", 0))
+        except Exception:
+            pass
+        report["memory"] = mem
+
+        # throughput (PerformanceListener's samples/sec, folded in)
+        if self._last_time is not None and iteration > (self._last_iter or 0):
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0:
+                report["perf"] = {
+                    "iterations_per_sec": iters / dt,
+                    "samples_per_sec":
+                        iters * getattr(model, "last_batch_size", 0) / dt,
+                }
+        self._last_time = now
+        self._last_iter = iteration
+
+        self.storage.put_update(self.session_id, self.TYPE_ID,
+                                self.worker_id, now, report)

@@ -10,14 +10,24 @@ and jax config beats env, so the in-process variant must call
 from __future__ import annotations
 
 import os
+import re
 from typing import Dict, Optional
 
 __all__ = ["child_env_with_virtual_devices", "provision_virtual_devices"]
 
+_FLAG_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
 
 def _with_flag(flags: str, n_devices: int) -> str:
-    if "xla_force_host_platform_device_count" in flags:
-        return flags
+    """Ensure XLA_FLAGS requests at least n_devices virtual devices — an
+    existing smaller count is raised (leaving it would make provisioning
+    N devices silently impossible); a larger one is kept."""
+    m = _FLAG_RE.search(flags)
+    if m:
+        if int(m.group(1)) >= n_devices:
+            return flags
+        return _FLAG_RE.sub(
+            f"--xla_force_host_platform_device_count={n_devices}", flags)
     return (flags + f" --xla_force_host_platform_device_count={n_devices}"
             ).strip()
 

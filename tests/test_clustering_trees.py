@@ -1,0 +1,142 @@
+"""Spatial structures + Barnes-Hut t-SNE tests.
+
+Brute-force-vs-tree equivalence is the reference's own test pattern
+(`deeplearning4j-core/src/test/.../clustering/kdtree/KDTreeTest.java`,
+`vptree/VpTreeNodeTest.java`); theta=0 Barnes-Hut == exact repulsion checks
+the SpTree against the dense formula.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, QuadTree, SpTree, VPTree
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+
+
+def _brute_knn(points, q, k):
+    d = np.sqrt(np.sum((points - q) ** 2, axis=1))
+    idx = np.argsort(d, kind="stable")[:k]
+    return [(float(d[i]), int(i)) for i in idx]
+
+
+def test_kdtree_matches_brute_force():
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(200, 5))
+    tree = KDTree(pts)
+    assert len(tree) == 200
+    for qi in range(10):
+        q = rng.normal(size=5)
+        got = tree.knn(q, 7)
+        want = _brute_knn(pts, q, 7)
+        assert [i for _, i in got] == [i for _, i in want]
+        np.testing.assert_allclose([d for d, _ in got],
+                                   [d for d, _ in want], rtol=1e-10)
+    idx, dist = tree.nn(pts[13] + 1e-9)
+    assert idx == 13
+
+
+def test_vptree_matches_brute_force():
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(150, 4))
+    tree = VPTree(pts)
+    for qi in range(10):
+        q = rng.normal(size=4)
+        got = tree.knn(q, 5)
+        want = _brute_knn(pts, q, 5)
+        assert [i for _, i in got] == [i for _, i in want]
+
+
+def test_vptree_cosine_metric():
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(100, 8))
+    tree = VPTree(pts, metric="cosine")
+    q = rng.normal(size=8)
+    got = tree.knn(q, 4)
+    unit = pts / np.linalg.norm(pts, axis=1, keepdims=True)
+    d = 1.0 - unit @ (q / np.linalg.norm(q))
+    want = np.argsort(d, kind="stable")[:4]
+    assert [i for _, i in got] == list(want)
+
+
+def test_sptree_theta_zero_is_exact():
+    """theta=0 forces full traversal -> exact repulsive forces."""
+    rng = np.random.default_rng(3)
+    y = rng.normal(size=(60, 2))
+    tree = QuadTree(y)
+    for i in (0, 17, 59):
+        neg, sum_q = tree.compute_non_edge_forces(i, theta=0.0)
+        diff = y[i] - y
+        d2 = np.sum(diff * diff, axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        want_sum = q.sum()
+        want_neg = ((q ** 2)[:, None] * diff).sum(axis=0)
+        np.testing.assert_allclose(sum_q, want_sum, rtol=1e-9)
+        np.testing.assert_allclose(neg, want_neg, rtol=1e-9, atol=1e-12)
+
+
+def test_sptree_theta_half_approximates():
+    rng = np.random.default_rng(4)
+    y = rng.normal(size=(200, 2))
+    tree = QuadTree(y)
+    exact_tree = QuadTree(y)
+    for i in (5, 100):
+        approx, sq_a = tree.compute_non_edge_forces(i, theta=0.5)
+        exact, sq_e = exact_tree.compute_non_edge_forces(i, theta=0.0)
+        assert abs(sq_a - sq_e) / sq_e < 0.05
+        np.testing.assert_allclose(approx, exact, rtol=0.15, atol=1e-3)
+
+
+def test_sptree_3d():
+    rng = np.random.default_rng(5)
+    y = rng.normal(size=(80, 3))
+    tree = SpTree(y)
+    neg, sum_q = tree.compute_non_edge_forces(0, theta=0.0)
+    diff = y[0] - y
+    q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+    q[0] = 0.0
+    np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-9)
+
+
+def test_sptree_handles_duplicate_points():
+    y = np.zeros((10, 2))
+    y[5:] = 1.0
+    tree = QuadTree(y)  # must not recurse forever
+    neg, sum_q = tree.compute_non_edge_forces(0, theta=0.5)
+    assert np.isfinite(sum_q)
+
+
+def _three_blobs(n_per=40, seed=6):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0, 0, 0], [8, 8, 0, 0], [0, 8, 8, 0]],
+                       dtype=np.float64)
+    xs, labels = [], []
+    for ci, c in enumerate(centers):
+        xs.append(c + rng.normal(scale=0.5, size=(n_per, 4)))
+        labels += [ci] * n_per
+    return np.concatenate(xs), np.array(labels)
+
+
+def test_barnes_hut_tsne_separates_clusters():
+    x, labels = _three_blobs()
+    ts = BarnesHutTsne(max_iter=250, perplexity=15.0, seed=1, theta=0.5)
+    y = ts.fit_transform(x)
+    assert y.shape == (x.shape[0], 2)
+    assert np.isfinite(ts.kl_divergence)
+    # cluster separation: mean intra-cluster distance well below inter
+    intra, inter = [], []
+    for a in range(3):
+        ya = y[labels == a]
+        intra.append(np.mean(np.linalg.norm(ya - ya.mean(0), axis=1)))
+        for b in range(a + 1, 3):
+            inter.append(np.linalg.norm(ya.mean(0) - y[labels == b].mean(0)))
+    assert min(inter) > 2.0 * max(intra), (intra, inter)
+
+
+def test_barnes_hut_get_data_and_export(tmp_path):
+    x, labels = _three_blobs(n_per=15)
+    ts = BarnesHutTsne(max_iter=60, perplexity=8.0, seed=2)
+    ts.fit(x)
+    assert ts.get_data().shape == (45, 2)
+    out = tmp_path / "tsne.csv"
+    ts.save_as_file([str(l) for l in labels], str(out))
+    assert len(out.read_text().splitlines()) == 45

@@ -74,3 +74,65 @@ def test_clone_independent_buffers():
     m.fit(DataSet(x, y))  # donates m's old buffers
     out = c.output(x)  # must not touch deleted buffers
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_last_time_step_with_mask_trains():
+    """LastTimeStep must clear the [B,T] mask so downstream per-example
+    losses don't broadcast against it (round-2 review regression)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (Adam, DataSet, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, LastTimeStep
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(1e-2)).list()
+            .layer(GravesLSTM(n_out=6))
+            .layer(LastTimeStep())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 7))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 7, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)]
+    fmask = np.ones((5, 7), np.float32)
+    fmask[:, 4:] = 0.0  # variable-length: only 4 valid steps
+    m.fit(DataSet(x, y, features_mask=fmask))
+    assert np.isfinite(m.score())
+    # masked steps must not influence the output
+    x2 = x.copy()
+    x2[:, 4:, :] = 99.0
+    o1 = np.asarray(m.output(x, features_mask=fmask))
+    o2 = np.asarray(m.output(x2, features_mask=fmask))
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+
+
+def test_line_search_maximize():
+    """minimize=False line-search must walk the score uphill (round-2
+    review regression)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (DataSet, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.conf import OptimizationAlgorithm
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = rng.normal(size=(32, 1)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0)
+            .optimization_algo(OptimizationAlgorithm.LINE_GRADIENT_DESCENT)
+            .minimize(False)
+            .list()
+            .layer(OutputLayer(n_out=1, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    m.fit(ds)
+    s0 = m.score()
+    for _ in range(5):
+        m.fit(ds)
+    assert m.score() > s0  # mse grows when maximizing
