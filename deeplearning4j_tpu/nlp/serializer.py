@@ -25,11 +25,21 @@ __all__ = ["WordVectorSerializer"]
 class WordVectorSerializer:
     # --------------------------- text ---------------------------------
     @staticmethod
+    def _open_text(path: str, mode: str):
+        """Transparent gzip for .gz paths (the reference's
+        readWord2VecVectors gzip support in WordVectorSerializer)."""
+        if path.endswith(".gz"):
+            import gzip
+
+            return gzip.open(path, mode + "t", encoding="utf-8")
+        return open(path, mode, encoding="utf-8")
+
+    @staticmethod
     def write_word_vectors(model: WordVectorsModel, path: str,
                            header: bool = False):
         m = model.lookup_table.vectors_matrix()
         words = model.vocab.words()
-        with open(path, "w", encoding="utf-8") as f:
+        with WordVectorSerializer._open_text(path, "w") as f:
             if header:
                 f.write(f"{len(words)} {m.shape[1]}\n")
             for i, w in enumerate(words):
@@ -39,7 +49,7 @@ class WordVectorSerializer:
     @staticmethod
     def read_word_vectors(path: str) -> WordVectorsModel:
         words, vecs = [], []
-        with open(path, encoding="utf-8") as f:
+        with WordVectorSerializer._open_text(path, "r") as f:
             first = f.readline().rstrip("\n")
             parts = first.split(" ")
             if len(parts) == 2 and all(p.isdigit() for p in parts):

@@ -106,10 +106,11 @@ class VocabConstructor:
 
     def build_vocab(self, token_sequences: Iterable[Sequence[str]],
                     labels: Iterable[Sequence[str]] = ()) -> VocabCache:
-        counts: Dict[str, float] = {}
+        from collections import Counter
+
+        counts: Dict[str, float] = Counter()
         for seq in token_sequences:
-            for tok in seq:
-                counts[tok] = counts.get(tok, 0.0) + 1.0
+            counts.update(seq)
         cache = VocabCache()
         for w, c in counts.items():
             if c >= self.min_word_frequency or w in self.special_tokens:

@@ -106,8 +106,46 @@ class SequenceVectors(WordVectorsModel):
         idx = [self.vocab.index_of(t) for t in tokens]
         return np.array([i for i in idx if i >= 0], np.int32)
 
+    def _gen_pairs_sg_fast(self, seqs) -> Dict[str, np.ndarray]:
+        """Fully vectorized skip-gram pair generation: the whole corpus is
+        flattened into one index array with sentence ids, and for each window
+        offset d the (center, context) pairs come from boolean masks — W
+        numpy passes instead of a Python loop per token (the host-side
+        bottleneck the reference spreads over Hogwild threads,
+        `SequenceVectors.java:289`). Keeps the per-center random reduced
+        window b ~ U[1, W] semantics of `SkipGram.java`."""
+        flat_parts, sid_parts = [], []
+        for si, (tokens, _labels) in enumerate(seqs):
+            idx = self._subsample(self._to_indices(tokens))
+            if len(idx) < 2:
+                continue
+            flat_parts.append(idx)
+            sid_parts.append(np.full(len(idx), si, np.int64))
+        if not flat_parts:
+            return {}
+        flat = np.concatenate(flat_parts)
+        sid = np.concatenate(sid_parts)
+        n = len(flat)
+        W = self.window_size
+        b = self._np_rng.integers(1, W + 1, n)
+        centers, ctxs = [], []
+        for d in range(1, W + 1):
+            same = sid[:-d] == sid[d:]
+            right = same & (d <= b[:-d])   # center i  -> context i+d
+            left = same & (d <= b[d:])     # center i+d -> context i
+            centers.append(flat[:-d][right])
+            ctxs.append(flat[d:][right])
+            centers.append(flat[d:][left])
+            ctxs.append(flat[:-d][left])
+        c = np.concatenate(centers).astype(np.int32)
+        x = np.concatenate(ctxs).astype(np.int32)
+        return {"sg": (c, x)} if len(c) else {}
+
     def _gen_pairs(self, seqs) -> Dict[str, np.ndarray]:
         """Generate training examples host-side (vectorized per sentence)."""
+        if (self.train_elements and not self.train_sequences
+                and self.elements_algo == "skipgram"):
+            return self._gen_pairs_sg_fast(seqs)
         sg_c, sg_x = [], []
         cb_c, cb_x = [], []
         seq_c, seq_x = [], []

@@ -193,6 +193,38 @@ class MultiLayerNetwork:
             lr = lr * (base / sched.base_lr)
         return lr
 
+    def apply_layer_updates(self, layers, params, grads, opt_state, step):
+        """Apply per-layer updaters to a (sub)list of layers — the update
+        half of the train step, shared with the pipeline trainer which
+        updates one stage's layer slice at a time. Pure/traceable."""
+        new_params, new_opt = [], []
+        for layer, p, g, os in zip(layers, params, grads, opt_state):
+            if not p or layer.frozen:
+                new_params.append(p)
+                new_opt.append(os)
+                continue
+            g = apply_gradient_normalization(
+                layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0, g)
+            upd = self._layer_updater(layer)
+            lr = self._layer_lr(layer, step)
+            updates, os = upd.update(g, os, step, lr)
+            if layer.bias_learning_rate is not None:
+                # lr may be a traced scalar (schedule); avoid python
+                # truthiness on it. Updater steps are linear in lr, so
+                # rescaling bias updates by bias_lr/lr is exact.
+                if lr is None:
+                    eff = getattr(upd, "learning_rate", 1.0) or 1.0
+                    scale = layer.bias_learning_rate / eff
+                else:
+                    scale = layer.bias_learning_rate / jnp.maximum(
+                        jnp.asarray(lr, jnp.float32), 1e-30)
+                updates = {k: (v * scale if k == "b" or "bias" in k else v)
+                           for k, v in updates.items()}
+            new_params.append({k: p[k] - updates[k] for k in p})
+            new_opt.append(os)
+        return new_params, new_opt
+
     def _make_train_step(self):
         def train_step(params, state, opt_state, step, x, y, rng, fmask,
                        lmask, carries=None):
@@ -202,33 +234,8 @@ class MultiLayerNetwork:
                                              carries=carries)
             if not self.conf.conf.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
-            new_params, new_opt = [], []
-            for i, layer in enumerate(self.layers):
-                p, g, os = params[i], grads[i], opt_state[i]
-                if not p or layer.frozen:
-                    new_params.append(p)
-                    new_opt.append(os)
-                    continue
-                g = apply_gradient_normalization(
-                    layer.gradient_normalization,
-                    layer.gradient_normalization_threshold or 1.0, g)
-                upd = self._layer_updater(layer)
-                lr = self._layer_lr(layer, step)
-                updates, os = upd.update(g, os, step, lr)
-                if layer.bias_learning_rate is not None:
-                    # lr may be a traced scalar (schedule); avoid python
-                    # truthiness on it. Updater steps are linear in lr, so
-                    # rescaling bias updates by bias_lr/lr is exact.
-                    if lr is None:
-                        eff = getattr(upd, "learning_rate", 1.0) or 1.0
-                        scale = layer.bias_learning_rate / eff
-                    else:
-                        scale = layer.bias_learning_rate / jnp.maximum(
-                            jnp.asarray(lr, jnp.float32), 1e-30)
-                    updates = {k: (v * scale if k == "b" or "bias" in k else v)
-                               for k, v in updates.items()}
-                new_params.append({k: p[k] - updates[k] for k in p})
-                new_opt.append(os)
+            new_params, new_opt = self.apply_layer_updates(
+                self.layers, params, grads, opt_state, step)
             if carries is None:
                 return tuple(new_params), new_state, tuple(new_opt), score
             # TBPTT chunk step: carries cross chunk boundaries as *inputs*, so

@@ -3,7 +3,9 @@ from .sharding import ShardingStrategy, param_specs, shard_model
 from .trainer import ParallelTrainer, ParallelWrapper, TrainingMode
 from .ring_attention import (blockwise_attention, local_attention_reference,
                              ring_attention_sharded, ring_self_attention)
-from .pipeline import PipelinedDenseStack, pipeline_forward
+from .stats import TrainingStats, profiler_trace
+from .pipeline import (PipelinedDenseStack,
+                       PipelinedNetworkTrainer, pipeline_forward)
 from .distributed import (global_mesh, initialize, is_multi_host,
                           local_batch_slice, process_index)
 from .checkpoint import ShardedCheckpoint, restore_sharded, save_sharded
@@ -14,7 +16,7 @@ __all__ = [
     "ParallelTrainer", "ParallelWrapper", "TrainingMode",
     "blockwise_attention", "local_attention_reference",
     "ring_attention_sharded", "ring_self_attention",
-    "PipelinedDenseStack", "pipeline_forward",
+    "TrainingStats", "profiler_trace", "PipelinedDenseStack", "PipelinedNetworkTrainer", "pipeline_forward",
     "global_mesh", "initialize", "is_multi_host", "local_batch_slice",
     "process_index",
     "ShardedCheckpoint", "restore_sharded", "save_sharded",

@@ -22,7 +22,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_forward", "PipelinedDenseStack"]
+from ..datasets.iterators import DataSet
+
+__all__ = ["pipeline_forward", "PipelinedDenseStack",
+           "PipelinedNetworkTrainer"]
 
 
 def pipeline_forward(stage_fn: Callable, stacked_params, x_microbatches,
@@ -126,3 +129,287 @@ class PipelinedDenseStack:
         params = jax.device_put(params, stage_sh)
         out = jax.jit(wrapper)(params, xm)
         return out.reshape(B, self.features)
+
+
+class PipelinedNetworkTrainer:
+    """GPipe-schedule pipeline training for a REAL `MultiLayerNetwork`
+    (heterogeneous stages — the capability `PipelinedDenseStack` only
+    templated).
+
+    Contiguous layer ranges (balanced by parameter count, or explicit
+    `boundaries`) become stages pinned to the devices of the mesh's `pipe`
+    axis. A training step runs the GPipe two-phase schedule host-side:
+    forward all microbatches stage by stage (boundary activations stay on
+    each stage's device; inter-stage transfer is a device-to-device copy),
+    then backward per stage via `jax.vjp` with stage-granular recompute
+    (activation checkpointing at stage boundaries). Gradients average over
+    microbatches — identical to the single-device full-batch gradient for
+    mean losses, the equivalence the tests assert (the
+    `TestCompareParameterAveragingSparkVsSingleMachine.java:44` pattern).
+
+    Restrictions: feed-forward layers (no TBPTT carries), no masks.
+    """
+
+    def __init__(self, model, mesh: Mesh, axis: str = "pipe",
+                 n_microbatches: Optional[int] = None,
+                 boundaries: Optional[list] = None):
+        from ..nn.layers.feedforward import BaseOutputLayerConf
+
+        if model.params is None:
+            model.init()
+        self.model = model
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = mesh.shape[axis]
+        self.n_microbatches = n_microbatches or self.n_stages
+        n_layers = len(model.layers)
+        if self.n_stages > n_layers:
+            raise ValueError(f"{self.n_stages} stages > {n_layers} layers")
+        if not isinstance(model.layers[-1], BaseOutputLayerConf):
+            raise ValueError("last layer must be an output layer")
+        self.boundaries = (list(boundaries) if boundaries is not None
+                           else self._balance(n_layers))
+        # mesh devices along the pipe axis (first index in other axes)
+        idx = [0] * len(mesh.axis_names)
+        ax = mesh.axis_names.index(axis)
+        devs = []
+        for s in range(self.n_stages):
+            idx[ax] = s
+            devs.append(mesh.devices[tuple(idx)])
+        self.devices = devs
+        self._place_params()
+        self.iteration_count = 0
+        self._score = float("nan")
+        self._rng = (model._rng if getattr(model, "_rng", None) is not None
+                     else jax.random.PRNGKey(0))
+
+    # -- stage partitioning ---------------------------------------------
+    def _balance(self, n_layers: int) -> list:
+        """Contiguous split minimizing per-stage param-count imbalance
+        (greedy threshold; boundaries[s] = first layer of stage s+1)."""
+        sizes = [sum(int(np.prod(v.shape)) for v in p.values()) or 1
+                 for p in self.model.params]
+        total = sum(sizes)
+        target = total / self.n_stages
+        bounds, acc, need = [], 0.0, 1
+        for i, sz in enumerate(sizes):
+            remaining_layers = len(sizes) - i
+            remaining_stages = self.n_stages - need + 1
+            if (acc + sz / 2 >= target * need
+                    and need < self.n_stages
+                    and remaining_layers > remaining_stages - 1):
+                bounds.append(i)
+                need += 1
+            acc += sz
+        while len(bounds) < self.n_stages - 1:  # force S stages
+            for i in range(n_layers - 1, 0, -1):
+                if i not in bounds:
+                    bounds.append(i)
+                    break
+            bounds.sort()
+        return bounds[:self.n_stages - 1]
+
+    def _stage_range(self, s: int):
+        lo = 0 if s == 0 else self.boundaries[s - 1]
+        hi = (len(self.model.layers) if s == self.n_stages - 1
+              else self.boundaries[s])
+        return lo, hi
+
+    def _place_params(self):
+        self.stage_params, self.stage_state, self.stage_opt = [], [], []
+        for s in range(self.n_stages):
+            lo, hi = self._stage_range(s)
+            put = lambda t: jax.device_put(t, self.devices[s])
+            self.stage_params.append(put(tuple(self.model.params[lo:hi])))
+            self.stage_state.append(put(tuple(self.model.state[lo:hi])))
+            self.stage_opt.append(put(tuple(self.model.updater_state[lo:hi])))
+
+    # -- per-stage functions (jitted once per stage) ---------------------
+    def _stage_forward(self, s: int):
+        """(params, state, x) -> (y, new_state) through layers [lo, hi)."""
+        m = self.model
+        lo, hi = self._stage_range(s)
+        is_last = s == self.n_stages - 1
+
+        def fwd(params, state, x):
+            new_state = list(state)
+            for k, i in enumerate(range(lo, hi if not is_last else hi - 1)):
+                if i in m.conf.preprocessors:
+                    x = m.conf.preprocessors[i].apply(x)
+                x, new_state[k] = m.layers[i].apply(
+                    params[k], state[k], x, train=True, rng=None, mask=None)
+            return x, tuple(new_state)
+
+        return fwd
+
+    @functools.cached_property
+    def _stage_fwd_jits(self):
+        return [jax.jit(self._stage_forward(s))
+                for s in range(self.n_stages)]
+
+    @functools.cached_property
+    def _stage_bwd_jits(self):
+        """Stage backward with recompute: (params, state, x, cot) ->
+        (param_grads, x_cot, new_state)."""
+        jits = []
+        for s in range(self.n_stages):
+            fwd = self._stage_forward(s)
+
+            def bwd(params, state, x, cot, _fwd=fwd):
+                (y, new_state), vjp = jax.vjp(
+                    lambda p, xi: _fwd(p, state, xi), params, x)
+                gp, gx = vjp((cot, jax.tree_util.tree_map(jnp.zeros_like,
+                                                          new_state)))
+                return gp, gx, new_state
+            jits.append(jax.jit(bwd))
+        return jits
+
+    @functools.cached_property
+    def _last_stage_grad(self):
+        """Last stage: forward rest + loss; returns (loss, param_grads,
+        x_cot, new_state). Regularization is handled separately (it is
+        per-step, not per-microbatch)."""
+        m = self.model
+        s = self.n_stages - 1
+        lo, hi = self._stage_range(s)
+        fwd = self._stage_forward(s)
+        out_layer = m.layers[hi - 1]
+        out_k = hi - 1 - lo
+
+        def loss_fn(params, state, x, y):
+            h, new_state = fwd(params, state, x)
+            i = hi - 1
+            if i in m.conf.preprocessors:
+                h = m.conf.preprocessors[i].apply(h)
+            loss = out_layer.loss_score(params[out_k], state[out_k], h, y,
+                                        train=True, rng=None, mask=None)
+            return loss, new_state
+
+        def grad_fn(params, state, x, y):
+            (loss, new_state), vjp = jax.vjp(
+                lambda p, xi: loss_fn(p, state, xi, y), params, x)
+            gp, gx = vjp((jnp.float32(1.0),
+                          jax.tree_util.tree_map(jnp.zeros_like, new_state)))
+            return loss, gp, gx, new_state
+
+        return jax.jit(grad_fn)
+
+    @functools.cached_property
+    def _stage_reg_grads(self):
+        """Per-stage d(reg)/d(params); added once per step scaled 1/B."""
+        jits = []
+        for s in range(self.n_stages):
+            lo, hi = self._stage_range(s)
+            layers = self.model.layers[lo:hi]
+
+            def reg(params, _layers=layers):
+                total = jnp.float32(0.0)
+                for layer, p in zip(_layers, params):
+                    if p:
+                        total = total + layer.reg_score(p)
+                return total
+            jits.append(jax.jit(jax.value_and_grad(reg)))
+        return jits
+
+    @functools.cached_property
+    def _stage_update_jits(self):
+        jits = []
+        for s in range(self.n_stages):
+            lo, hi = self._stage_range(s)
+            layers = self.model.layers[lo:hi]
+
+            def upd(params, grads, opt, step, _layers=layers):
+                p, o = self.model.apply_layer_updates(
+                    _layers, params, grads, opt, step)
+                return tuple(p), tuple(o)
+            jits.append(jax.jit(upd))
+        return jits
+
+    # -- training --------------------------------------------------------
+    def fit(self, data, epochs: int = 1):
+        if isinstance(data, DataSet):
+            self._fit_batch(data)
+            return self
+        for _ in range(epochs):
+            data.reset()
+            while data.has_next():
+                self._fit_batch(data.next())
+        return self
+
+    def _fit_batch(self, ds: DataSet):
+        if ds.features_mask is not None or ds.labels_mask is not None:
+            raise ValueError("pipeline trainer does not support masks")
+        x = np.asarray(ds.features)
+        y = np.asarray(ds.labels)
+        B = x.shape[0]
+        M = self.n_microbatches
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        xs = np.split(x, M)
+        ys = np.split(y, M)
+        S = self.n_stages
+        step = jnp.asarray(self.iteration_count, jnp.int32)
+
+        # forward phase: boundary activations per (microbatch, stage)
+        acts = [[None] * S for _ in range(M)]
+        for mi in range(M):
+            a = jax.device_put(jnp.asarray(xs[mi]), self.devices[0])
+            for s in range(S - 1):
+                acts[mi][s] = a
+                a, _ = self._stage_fwd_jits[s](self.stage_params[s],
+                                               self.stage_state[s], a)
+                a = jax.device_put(a, self.devices[min(s + 1, S - 1)])
+            acts[mi][S - 1] = a
+
+        # backward phase: per-stage grad accumulation over microbatches
+        grad_acc = [None] * S
+        losses = []
+        new_states = list(self.stage_state)
+        for mi in range(M):
+            yb = jax.device_put(jnp.asarray(ys[mi]), self.devices[S - 1])
+            loss, gp, cot, st = self._last_stage_grad(
+                self.stage_params[S - 1], self.stage_state[S - 1],
+                acts[mi][S - 1], yb)
+            losses.append(loss)
+            new_states[S - 1] = st
+            grad_acc[S - 1] = gp if grad_acc[S - 1] is None else \
+                jax.tree_util.tree_map(jnp.add, grad_acc[S - 1], gp)
+            for s in range(S - 2, -1, -1):
+                cot = jax.device_put(cot, self.devices[s])
+                gp, cot, st = self._stage_bwd_jits[s](
+                    self.stage_params[s], self.stage_state[s],
+                    acts[mi][s], cot)
+                new_states[s] = st
+                grad_acc[s] = gp if grad_acc[s] is None else \
+                    jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
+
+        # update phase (mean over microbatches + reg/B, then updaters)
+        reg_total = 0.0
+        for s in range(S):
+            g = jax.tree_util.tree_map(lambda a: a / M, grad_acc[s])
+            reg_v, reg_g = self._stage_reg_grads[s](self.stage_params[s])
+            g = jax.tree_util.tree_map(lambda a, b: a + b / B, g, reg_g)
+            reg_total = reg_total + jax.device_get(reg_v)
+            self.stage_params[s], self.stage_opt[s] = \
+                self._stage_update_jits[s](self.stage_params[s], g,
+                                           self.stage_opt[s], step)
+        self.stage_state = new_states
+        self._score = float(np.mean([jax.device_get(l) for l in losses])
+                            + reg_total / B)
+        self.iteration_count += 1
+
+    def score(self) -> float:
+        return float(self._score)
+
+    def sync_back(self):
+        """Copy stage params/state/updater-state back into the model."""
+        params, state, opt = [], [], []
+        for s in range(self.n_stages):
+            params.extend(jax.device_get(self.stage_params[s]))
+            state.extend(jax.device_get(self.stage_state[s]))
+            opt.extend(jax.device_get(self.stage_opt[s]))
+        to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        self.model.params = tuple(to_dev(p) for p in params)
+        self.model.state = tuple(to_dev(s) for s in state)
+        self.model.updater_state = tuple(to_dev(o) for o in opt)
+        return self.model

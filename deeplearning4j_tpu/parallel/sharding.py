@@ -32,6 +32,7 @@ class ShardingStrategy:
     REPLICATED = "replicated"
     TENSOR_PARALLEL = "tensor_parallel"
     FSDP = "fsdp"
+    PIPELINE = "pipeline"  # stage-partitioned layers (PipelinedNetworkTrainer)
 
 
 def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh):

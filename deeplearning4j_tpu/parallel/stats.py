@@ -1,0 +1,122 @@
+"""Per-phase distributed-training stats + profiler hooks.
+
+Parity with the reference's Spark timing instrumentation:
+`dl4j-spark/.../api/stats/SparkTrainingStats.java` (keyed phase timings),
+`impl/paramavg/stats/ParameterAveragingTrainingMasterStats.java` (broadcast /
+fit / aggregate phases as EventStats) and `stats/StatsUtils.java` (HTML
+timeline export). TPU phases are: host data prep, device step
+(compute+collective, one jit), and parameter averaging — plus a
+`jax.profiler` trace hook for the XLA-level view (the role NTP-aligned
+EventStats played across Spark executors is covered by the profiler's own
+timeline).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["TrainingStats", "profiler_trace"]
+
+
+class _Event:
+    __slots__ = ("key", "start", "duration_ms")
+
+    def __init__(self, key: str, start: float, duration_ms: float):
+        self.key = key
+        self.start = start
+        self.duration_ms = duration_ms
+
+
+class TrainingStats:
+    """Keyed phase timings (`SparkTrainingStats` analog). Phases are timed
+    with `with stats.time("step"):` blocks; values are wall-clock ms.
+    NOTE: timing a phase that only *dispatches* async device work measures
+    dispatch unless the caller synchronizes — ParallelTrainer's
+    collect_stats mode blocks on the score each step for honest numbers."""
+
+    def __init__(self):
+        self._events: List[_Event] = []
+        self._t0 = time.time()
+
+    @contextlib.contextmanager
+    def time(self, key: str):
+        start = time.time()
+        try:
+            yield
+        finally:
+            self._events.append(
+                _Event(key, start - self._t0, (time.time() - start) * 1e3))
+
+    def add(self, key: str, duration_ms: float):
+        self._events.append(
+            _Event(key, time.time() - self._t0, float(duration_ms)))
+
+    # -- SparkTrainingStats surface --------------------------------------
+    def get_keys(self) -> List[str]:
+        seen = []
+        for e in self._events:
+            if e.key not in seen:
+                seen.append(e.key)
+        return seen
+
+    def get_values_for_key(self, key: str) -> List[float]:
+        return [e.duration_ms for e in self._events if e.key == key]
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for key in self.get_keys():
+            vs = self.get_values_for_key(key)
+            out[key] = {"count": len(vs), "total_ms": sum(vs),
+                        "mean_ms": sum(vs) / len(vs),
+                        "max_ms": max(vs)}
+        return out
+
+    def as_json(self) -> str:
+        return json.dumps(self.summary(), indent=2)
+
+    def export_html(self, path: str):
+        """Single-file timeline (`StatsUtils.exportStatsAsHtml` analog)."""
+        keys = self.get_keys()
+        colors = ["#c33", "#36c", "#393", "#939", "#c93", "#399"]
+        rows = []
+        span = max((e.start + e.duration_ms / 1e3 for e in self._events),
+                   default=1.0) or 1.0
+        for e in self._events:
+            lane = keys.index(e.key)
+            left = 100.0 * e.start / span
+            width = max(0.2, 100.0 * (e.duration_ms / 1e3) / span)
+            rows.append(
+                f'<div class="ev" style="top:{28 * lane + 40}px;'
+                f'left:{left:.2f}%;width:{width:.2f}%;background:'
+                f'{colors[lane % len(colors)]}" title="{e.key} '
+                f'{e.duration_ms:.2f} ms"></div>')
+        labels = "".join(
+            f'<div style="position:absolute;top:{28 * i + 40}px;left:4px;'
+            f'font-size:11px">{k}</div>' for i, k in enumerate(keys))
+        html = ("<!DOCTYPE html><html><head><style>"
+                ".ev{position:absolute;height:20px;opacity:.85;"
+                "border-radius:2px}</style></head><body>"
+                "<h3>Training phase timeline</h3>"
+                f'<div style="position:relative;height:{28 * len(keys) + 60}px;'
+                'border:1px solid #ccc;margin-left:120px">'
+                + "".join(rows) + "</div>"
+                + f'<div style="position:absolute;top:0;left:0">{labels}</div>'
+                + f"<pre>{self.as_json()}</pre></body></html>")
+        with open(path, "w") as f:
+            f.write(html)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """jax profiler trace context — the XLA-level timeline (TensorBoard
+    `trace_viewer`). The TPU-native analog of the reference's per-executor
+    EventStats + NTP alignment (device events are already on one clock)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -61,7 +61,8 @@ class ParallelTrainer:
                  averaging_frequency: int = 5,
                  average_updaters: bool = True,
                  data_axis: str = MeshAxes.DATA,
-                 model_axis: str = MeshAxes.MODEL):
+                 model_axis: str = MeshAxes.MODEL,
+                 collect_stats: bool = False):
         if model.params is None:
             model.init()
         self.model = model
@@ -70,8 +71,28 @@ class ParallelTrainer:
         self.strategy = strategy
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.average_updaters = average_updaters
+        # per-phase timing (SparkTrainingStats analog); adds one host sync
+        # per step, so it's opt-in like the reference's collectTrainingStats
+        self.stats = None
+        if collect_stats:
+            from .stats import TrainingStats
+
+            self.stats = TrainingStats()
         self.data_axis = data_axis
         self.model_axis = model_axis
+        if strategy == ShardingStrategy.PIPELINE:
+            # stage-partitioned training of a real MultiLayerNetwork: the
+            # mesh must carry a "pipe" axis; delegate to the GPipe trainer
+            from .mesh import MeshAxes
+            from .pipeline import PipelinedNetworkTrainer
+
+            axis = (MeshAxes.PIPE if MeshAxes.PIPE in self.mesh.axis_names
+                    else data_axis)
+            self._pipe = PipelinedNetworkTrainer(model, self.mesh, axis=axis)
+            self.n_data = 1
+            self.iteration_count = 0
+            return
+        self._pipe = None
         self.n_data = self.mesh.shape[data_axis]
         if mode == TrainingMode.AVERAGING and strategy != ShardingStrategy.REPLICATED:
             raise ValueError("averaging mode requires replicated params")
@@ -162,6 +183,11 @@ class ParallelTrainer:
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):
+        if self._pipe is not None:
+            self._pipe.fit(data, epochs=epochs)
+            self.iteration_count = self._pipe.iteration_count
+            self._pipe.sync_back()
+            return self
         if isinstance(data, DataSet):
             self._fit_batch(data)
         else:
@@ -173,36 +199,55 @@ class ParallelTrainer:
         return self
 
     def _fit_batch(self, ds: DataSet):
-        x = np.asarray(ds.features)
-        y = np.asarray(ds.labels)
-        n = self.n_data
-        if x.shape[0] % n:
-            # pad the global batch to a multiple of the data axis (the
-            # reference round-robins leftovers; padding + weight-0 would alter
-            # loss scale — we simply drop the remainder like drop_last)
-            keep = (x.shape[0] // n) * n
-            if keep == 0:
-                return
-            x, y = x[:keep], y[:keep]
+        import contextlib
+
+        phase = (self.stats.time if self.stats is not None
+                 else (lambda key: contextlib.nullcontext()))
+        with phase("data"):
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            n = self.n_data
+            if x.shape[0] % n:
+                # pad the global batch to a multiple of the data axis (the
+                # reference round-robins leftovers; padding + weight-0 would
+                # alter loss scale — we simply drop the remainder)
+                keep = (x.shape[0] // n) * n
+                if keep == 0:
+                    return
+                x, y = x[:keep], y[:keep]
+            xd, yd = jnp.asarray(x), jnp.asarray(y)
         self._rng, rng = jax.random.split(self._rng)
         step = jnp.asarray(self.iteration_count, jnp.int32)
         if self.mode == TrainingMode.SYNC:
-            self._params, self._state, self._opt, score = self._step_fn(
-                self._params, self._state, self._opt, step,
-                jnp.asarray(x), jnp.asarray(y), rng, None, None)
-            self._score = score
+            with phase("step"):
+                self._params, self._state, self._opt, score = self._step_fn(
+                    self._params, self._state, self._opt, step,
+                    xd, yd, rng, None, None)
+                self._score = score
+                if self.stats is not None:
+                    float(jnp.asarray(score))  # sync for honest timing
         else:
-            xs = jnp.asarray(x.reshape(n, -1, *x.shape[1:]))
-            ys = jnp.asarray(y.reshape(n, -1, *y.shape[1:]))
-            self._params, self._state, self._opt, scores = self._local_step(
-                self._params, self._state, self._opt, step, xs, ys, rng)
-            self._score = scores.mean()
+            with phase("step"):
+                xs = xd.reshape(n, -1, *x.shape[1:])
+                ys = yd.reshape(n, -1, *y.shape[1:])
+                (self._params, self._state, self._opt,
+                 scores) = self._local_step(
+                    self._params, self._state, self._opt, step, xs, ys, rng)
+                self._score = scores.mean()
+                if self.stats is not None:
+                    float(jnp.asarray(self._score))
             if (self.iteration_count + 1) % self.averaging_frequency == 0:
-                self._params, self._opt = self._average(self._params,
-                                                        self._opt)
+                with phase("average"):
+                    self._params, self._opt = self._average(self._params,
+                                                            self._opt)
+                    if self.stats is not None:
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(self._params)[0])
         self.iteration_count += 1
 
     def score(self) -> float:
+        if self._pipe is not None:
+            return self._pipe.score()
         return float(jnp.asarray(self._score).mean())
 
     def _sync_back(self):

@@ -1,0 +1,91 @@
+"""Node2Vec + serializer format tests.
+
+Reference pattern: NLP suites assert similarity structure, not exact numbers
+(`deeplearning4j-graph/src/test/.../deepwalk/DeepWalkTest.java` style); the
+walker's p/q bias is checked statistically against the Grover-Leskovec
+transition rule.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graphlib import Graph, Node2Vec, Node2VecWalker
+
+
+def _barbell(n=6):
+    """Two cliques of n joined by one bridge edge: community structure that
+    node2vec embeddings must reflect."""
+    g = Graph(2 * n)
+    for base in (0, n):
+        for i in range(n):
+            for j in range(i + 1, n):
+                g.add_edge(base + i, base + j)
+    g.add_edge(n - 1, n)
+    return g
+
+
+def test_walker_respects_walk_length_and_connectivity():
+    g = _barbell()
+    walker = Node2VecWalker(g, walk_length=10, p=1.0, q=1.0, seed=0)
+    for walk in list(walker.walks(1))[:5]:
+        assert len(walk) == 10
+        for a, b in zip(walk, walk[1:]):
+            assert b in g.neighbors(a)
+
+
+def test_walker_p_bias_controls_returns():
+    """Small p -> frequent immediate backtracking; large p -> rare."""
+    g = _barbell()
+
+    def return_rate(p):
+        walker = Node2VecWalker(g, walk_length=30, p=p, q=1.0, seed=1)
+        returns = steps = 0
+        for walk in walker.walks(3):
+            for i in range(2, len(walk)):
+                steps += 1
+                if walk[i] == walk[i - 2]:
+                    returns += 1
+        return returns / steps
+
+    assert return_rate(0.05) > return_rate(20.0) + 0.05
+
+
+def test_node2vec_embeds_communities():
+    g = _barbell()
+    n2v = Node2Vec(vector_size=32, walk_length=20, walks_per_vertex=20,
+                   window_size=4, p=1.0, q=0.5, seed=3, epochs=3)
+    n2v.fit(g)
+    emb = np.stack([n2v.vertex_vector(i) for i in range(12)])
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    # same-clique similarity should beat cross-clique (bridge nodes excluded)
+    same = np.mean([emb[i] @ emb[j] for i in range(5) for j in range(5)
+                    if i != j])
+    cross = np.mean([emb[i] @ emb[j] for i in range(5) for j in range(7, 12)])
+    assert same > cross + 0.1, (same, cross)
+
+
+def test_word_vector_serializer_gzip_round_trip(tmp_path):
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+    g = _barbell()
+    n2v = Node2Vec(vector_size=8, walk_length=10, walks_per_vertex=2, seed=0)
+    n2v.fit(g)
+    path = str(tmp_path / "vecs.txt.gz")
+    WordVectorSerializer.write_word_vectors(n2v, path, header=True)
+    back = WordVectorSerializer.read_word_vectors(path)
+    w = n2v.vocab.words()[0]
+    np.testing.assert_allclose(back.word_vector(w), n2v.word_vector(w),
+                               atol=1e-5)
+
+
+def test_google_binary_round_trip(tmp_path):
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+    g = _barbell()
+    n2v = Node2Vec(vector_size=8, walk_length=10, walks_per_vertex=2, seed=0)
+    n2v.fit(g)
+    path = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(n2v, path)
+    back = WordVectorSerializer.read_binary(path)
+    for w in n2v.vocab.words()[:5]:
+        np.testing.assert_allclose(back.word_vector(w), n2v.word_vector(w),
+                                   atol=1e-6)

@@ -1,0 +1,73 @@
+"""Per-phase training stats (SparkTrainingStats analog) tests.
+
+Reference pattern: `dl4j-spark/src/test/.../impl/stats/
+TestTrainingStatsCollection.java` — collect stats during a short training
+run, assert keys/counts, export round-trip.
+"""
+import json
+import os
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.parallel import (ParallelTrainer, TrainingMode,
+                                         TrainingStats, make_mesh)
+
+
+def _model():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ds(n=64):
+    rng = np.random.default_rng(0)
+    return DataSet(rng.normal(size=(n, 8)).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)])
+
+
+def test_training_stats_units():
+    st = TrainingStats()
+    with st.time("fit"):
+        pass
+    st.add("broadcast", 12.5)
+    st.add("fit", 3.0)
+    assert st.get_keys() == ["fit", "broadcast"]
+    assert st.get_values_for_key("broadcast") == [12.5]
+    s = st.summary()
+    assert s["fit"]["count"] == 2
+    json.loads(st.as_json())
+
+
+def test_sync_trainer_collects_phase_stats():
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    tr = ParallelTrainer(_model(), mesh=mesh, mode=TrainingMode.SYNC,
+                         collect_stats=True)
+    ds = _ds()
+    for _ in range(3):
+        tr.fit(ds)
+    assert set(tr.stats.get_keys()) == {"data", "step"}
+    assert len(tr.stats.get_values_for_key("step")) == 3
+    assert all(v > 0 for v in tr.stats.get_values_for_key("step"))
+
+
+def test_averaging_trainer_collects_average_phase(tmp_path):
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    tr = ParallelTrainer(_model(), mesh=mesh, mode=TrainingMode.AVERAGING,
+                         averaging_frequency=2, collect_stats=True)
+    ds = _ds()
+    for _ in range(4):
+        tr.fit(ds)
+    assert "average" in tr.stats.get_keys()
+    assert len(tr.stats.get_values_for_key("average")) == 2
+    out = str(tmp_path / "timeline.html")
+    tr.stats.export_html(out)
+    html = open(out).read()
+    assert "Training phase timeline" in html and "average" in html
