@@ -22,7 +22,7 @@ import sys
 import time
 
 
-def bench_word2vec(n_sentences=20000, sent_len=20, vocab=10000, epochs=1,
+def bench_word2vec(n_sentences=100000, sent_len=20, vocab=10000, epochs=1,
                    batch_words=8192):
     """words/sec for batched skip-gram negative sampling (BASELINE #4) on a
     synthetic zipf corpus (throughput; accuracy is covered by tests/test_nlp)."""

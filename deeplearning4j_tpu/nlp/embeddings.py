@@ -29,24 +29,39 @@ import numpy as np
 from .vocab import Huffman, VocabCache
 
 __all__ = ["InMemoryLookupTable", "NegativeSampler", "make_skipgram_step",
-           "make_cbow_step", "WordVectorsModel"]
+           "make_cbow_step", "make_epoch_runner", "pad_scan_length",
+           "WordVectorsModel"]
+
+
+def pad_scan_length(T: int) -> int:
+    """Bucket a scan length so epoch runners compile O(1) times even though
+    the pair/token count jitters between epochs (random reduced windows,
+    subsampling): next power of two below 64, else next multiple of 64.
+    Padded steps run with lr=0 — exact no-ops."""
+    if T >= 64:
+        return -(-T // 64) * 64
+    p = 1
+    while p < T:
+        p *= 2
+    return p
 
 
 class NegativeSampler:
     """Unigram^0.75 distribution (the reference's negative-sampling table,
-    InMemoryLookupTable.makeTable) — sampled on device via Gumbel-max over
-    log-probs instead of a 100M-entry table."""
+    InMemoryLookupTable.makeTable) — sampled on device by inverse-CDF
+    (uniform draw + binary search over the cumulative distribution,
+    O(B*K*log V)) instead of a 100M-entry table."""
 
     def __init__(self, counts: np.ndarray, power: float = 0.75):
         p = np.asarray(counts, np.float64) ** power
         p = p / p.sum()
-        self.log_probs = jnp.asarray(np.log(np.maximum(p, 1e-30)),
-                                     jnp.float32)
+        self.probs = jnp.asarray(p, jnp.float32)
+        self.cdf = jnp.asarray(np.cumsum(p), jnp.float32)
 
     def sample(self, rng, shape) -> jax.Array:
-        g = jax.random.gumbel(rng, shape + (self.log_probs.shape[0],),
-                              jnp.float32)
-        return jnp.argmax(g + self.log_probs, axis=-1).astype(jnp.int32)
+        u = jax.random.uniform(rng, shape, jnp.float32)
+        idx = jnp.searchsorted(self.cdf, u, side="right")
+        return jnp.clip(idx, 0, self.cdf.shape[0] - 1).astype(jnp.int32)
 
 
 class InMemoryLookupTable:
@@ -214,6 +229,93 @@ def make_cbow_step(table: InMemoryLookupTable, window: int):
         return new0, new1, new1n, loss / centers.shape[0]
 
     return step
+
+
+def make_skipgram_corpus_runner(table: InMemoryLookupTable, window: int):
+    """Fully device-side SGNS epoch: the flattened corpus (word indices +
+    sentence ids) lives on device; each scanned step takes a batch of center
+    POSITIONS, gathers its own context windows (reduced-window b ~ U[1, W]
+    per center, masked at sentence boundaries — the same pair set as
+    `SkipGram.java`'s window loop), and applies the batched SGD update.
+    No host-side pair generation at all.
+
+    TPU-first redesign of the negative-sampling update: instead of gathering
+    K sampled rows per pair (row-scatter-bound on TPU — scatters serialize),
+    the step computes FULL-VOCAB logits `vc @ syn1neg.T` on the MXU and uses
+    the exact expectation of the NS loss, `K * E_{w~Pn}[log sigmoid(-vc.u_w)]`
+    (Pn = unigram^0.75). The gradient is then two dense matmuls (zero
+    syn1neg row-scatters; the positive term is a scalar gather from the
+    logits), and the only scatter left is the B center rows of syn0. The
+    expected-NS gradient is the exact mean of the reference's sampled
+    `SkipGram.java` update, with lower variance.
+
+    Returns run(syn0, syn1neg, corpus, sid, positions, lrs, rng) ->
+    (syn0, syn1neg, mean_loss) with positions: [T, B] int32."""
+    K = table.negative
+    assert K > 0, "corpus runner is NS-only; HS uses the pair path"
+    pn = table.sampler.probs
+    W = int(window)
+    offs = jnp.concatenate([jnp.arange(-W, 0), jnp.arange(1, W + 1)])
+
+    @jax.jit
+    def run(syn0, syn1neg, corpus, sid, positions, lrs, rng):
+        n = corpus.shape[0]
+
+        def body(carry, inp):
+            s0, s1n = carry
+            pos, lr, k = inp
+            b = jax.random.randint(k, pos.shape, 1, W + 1)
+            j = pos[:, None] + offs[None, :]
+            jc = jnp.clip(j, 0, n - 1)
+            valid = ((j >= 0) & (j < n)
+                     & (jnp.abs(offs)[None, :] <= b[:, None])
+                     & (sid[jc] == sid[pos][:, None]))
+            centers = corpus[pos]                       # [B]
+            ctx = corpus[jc]                            # [B, 2W]
+            vm = valid.astype(jnp.float32)
+            nvalid = jnp.sum(vm, axis=1)                # [B]
+            vc0 = s0[centers]                           # [B, D]
+
+            def loss_fn(vc, s1):
+                logits = vc @ s1.T                      # [B, V] — MXU
+                pos_l = jnp.sum(jax.nn.log_sigmoid(
+                    jnp.take_along_axis(logits, ctx, axis=1)) * vm)
+                neg_l = jnp.sum(
+                    K * nvalid * (jax.nn.log_sigmoid(-logits) @ pn))
+                return -(pos_l + neg_l)
+
+            loss, (gvc, gs1n) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(vc0, s1n)
+            s0 = s0.at[centers].add(-lr * gvc)
+            return (s0, s1n - lr * gs1n), loss
+
+        keys = jax.random.split(rng, positions.shape[0])
+        (syn0, syn1neg), losses = jax.lax.scan(
+            body, (syn0, syn1neg), (positions, lrs, keys))
+        return syn0, syn1neg, jnp.mean(losses)
+
+    return run
+
+
+def make_epoch_runner(step):
+    """lax.scan an epoch's worth of batched SGD steps in ONE device dispatch
+    (the per-batch Python loop costs more than the math at these sizes).
+    centers: [T, B]; contexts: [T, B] or [T, B, C]; lrs: [T]; keys: [T] PRNG
+    keys."""
+
+    @jax.jit
+    def run_epoch(syn0, syn1, syn1neg, centers, contexts, lrs, keys):
+        def body(carry, inp):
+            s0, s1, s1n = carry
+            c, x, lr, k = inp
+            s0, s1, s1n, loss = step(s0, s1, s1n, c, x, lr, k)
+            return (s0, s1, s1n), loss
+
+        (syn0, syn1, syn1neg), losses = jax.lax.scan(
+            body, (syn0, syn1, syn1neg), (centers, contexts, lrs, keys))
+        return syn0, syn1, syn1neg, jnp.mean(losses)
+
+    return run_epoch
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .embeddings import (InMemoryLookupTable, WordVectorsModel,
-                         make_cbow_step, make_skipgram_step)
+                         make_cbow_step, make_epoch_runner,
+                         make_skipgram_corpus_runner, make_skipgram_step,
+                         pad_scan_length)
 from .sentence_iterator import (BasicLabelAwareIterator, LabelAwareIterator,
                                 LabelsSource, SentenceIterator)
 from .tokenization import DefaultTokenizerFactory, TokenizerFactory
@@ -91,20 +93,38 @@ class SequenceVectors(WordVectorsModel):
             use_hs=self.use_hs, negative=self.negative)
         return seqs
 
+    def _keep_probs(self, idx: np.ndarray) -> np.ndarray:
+        """Frequent-word subsampling keep-probability (reference `sampling`
+        config) — single definition shared by both training paths."""
+        counts = self.vocab.counts_array()
+        freq = counts[idx] / counts.sum()
+        return np.minimum(1.0, np.sqrt(self.sampling / freq)
+                          + self.sampling / freq)
+
     def _subsample(self, idx: np.ndarray) -> np.ndarray:
-        """Frequent-word subsampling (reference `sampling` config)."""
         if self.sampling <= 0:
             return idx
-        counts = self.vocab.counts_array()
-        total = counts.sum()
-        freq = counts[idx] / total
-        keep_p = np.minimum(1.0, np.sqrt(self.sampling / freq)
-                            + self.sampling / freq)
-        return idx[self._np_rng.random(len(idx)) < keep_p]
+        return idx[self._np_rng.random(len(idx)) < self._keep_probs(idx)]
 
     def _to_indices(self, tokens: Sequence[str]) -> np.ndarray:
         idx = [self.vocab.index_of(t) for t in tokens]
         return np.array([i for i in idx if i >= 0], np.int32)
+
+    def _flatten_corpus(self, seqs, subsample: bool = True):
+        """Flatten the corpus to (word_indices, sentence_ids), optionally
+        with subsampling applied — the device-side SGNS runner's input.
+        One pass of dict lookups over all tokens, then pure numpy."""
+        g = {w: vw.index for w, vw in self.vocab._words.items()}.get
+        flat = np.fromiter((g(t, -1) for toks, _ in seqs for t in toks),
+                           np.int32)
+        lens = np.fromiter((len(toks) for toks, _ in seqs), np.int64)
+        sid = np.repeat(np.arange(len(lens), dtype=np.int32), lens)
+        keep = flat >= 0
+        flat, sid = flat[keep], sid[keep]
+        if subsample and self.sampling > 0 and len(flat):
+            m = self._np_rng.random(len(flat)) < self._keep_probs(flat)
+            flat, sid = flat[m], sid[m]
+        return flat, sid
 
     def _gen_pairs_sg_fast(self, seqs) -> Dict[str, np.ndarray]:
         """Fully vectorized skip-gram pair generation: the whole corpus is
@@ -207,6 +227,10 @@ class SequenceVectors(WordVectorsModel):
         seqs = self.build_vocab() if self.vocab is None else list(
             self._sequences())
         table = self.lookup_table
+        if (self.train_elements and not self.train_sequences
+                and self.elements_algo == "skipgram" and not self.use_hs
+                and self.negative > 0):
+            return self._fit_sg_corpus(seqs)
         sg_step = make_skipgram_step(table)
         cb_step = (make_cbow_step(table, self.window_size)
                    if (self.elements_algo == "cbow"
@@ -218,6 +242,7 @@ class SequenceVectors(WordVectorsModel):
         if syn1neg is None:
             syn1neg = jnp.zeros((1, 1), jnp.float32)
 
+        runners = {}
         for epoch in range(self.epochs):
             pairs = self._gen_pairs(seqs)
             tasks = []
@@ -246,22 +271,97 @@ class SequenceVectors(WordVectorsModel):
                     else:
                         contexts = np.concatenate([contexts, contexts[:pad]],
                                                   axis=0)
-                for i in range(0, len(centers), B):
-                    frac = min(1.0, done / total)
-                    lr = max(self.min_learning_rate,
-                             self.learning_rate * (1.0 - frac))
-                    rng, k = jax.random.split(rng)
-                    syn0, syn1, syn1neg, loss = step(
-                        syn0, syn1, syn1neg,
-                        jnp.asarray(centers[i:i + B]),
-                        jnp.asarray(contexts[i:i + B]),
-                        jnp.float32(lr), k)
-                    done += B
+                T = len(centers) // B
+                # one scanned device dispatch per (task, epoch): per-step lr
+                # keeps the reference's linear decay to min_learning_rate.
+                # Scan length is bucketed (padded steps get lr=0, exact
+                # no-ops) so pair-count jitter between epochs doesn't
+                # recompile the epoch graph.
+                T2 = pad_scan_length(T)
+                frac = np.minimum(1.0, (done + np.arange(T2) * B) / total)
+                lrs = np.maximum(self.min_learning_rate,
+                                 self.learning_rate * (1.0 - frac))
+                lrs[T:] = 0.0
+                centers = np.resize(centers, (T2 * B,))
+                contexts = np.resize(contexts,
+                                     (T2 * B,) + contexts.shape[1:])
+                rng, k = jax.random.split(rng)
+                keys = jax.random.split(k, T2)
+                runner = runners.get(kind)
+                if runner is None:
+                    runner = runners[kind] = make_epoch_runner(step)
+                syn0, syn1, syn1neg, _loss = runner(
+                    syn0, syn1, syn1neg,
+                    jnp.asarray(centers.reshape((T2, B))),
+                    jnp.asarray(contexts.reshape(
+                        (T2, B) + contexts.shape[1:])),
+                    jnp.asarray(lrs, jnp.float32), keys)
+                done += T * B
         table.syn0 = syn0
         if table.use_hs:
             table.syn1 = syn1
         if table.negative > 0:
             table.syn1neg = syn1neg
+        return self
+
+    def _fit_sg_corpus(self, seqs):
+        """SGNS fast path: corpus on device, windows + negatives generated
+        inside the scanned step (see make_skipgram_corpus_runner)."""
+        table = self.lookup_table
+        runner_key = (id(table), self.window_size)
+        if getattr(self, "_sg_runner_key", None) != runner_key:
+            self._sg_runner = make_skipgram_corpus_runner(
+                table, self.window_size)
+            self._sg_runner_key = runner_key
+        runner = self._sg_runner
+        rng = jax.random.PRNGKey(self.seed)
+        syn0, syn1neg = table.syn0, table.syn1neg
+        # batch_size counts PAIRS (as in the pair path); a center yields
+        # ~window pairs, so derive centers-per-step from it. Additionally cap
+        # by vocab size: batched-sum SGD diverges when the same row
+        # accumulates many stale-param pair gradients in one step, so keep
+        # expected per-row duplication ~O(window) (sequential SGD, which the
+        # reference uses, saturates instead — `SkipGram.java` per-pair axpy)
+        B = max(32, self.batch_size // max(1, self.window_size))
+        B = min(B, max(32, self.vocab.num_words()))
+        # flatten ONCE (token->index lookup is the host-side cost); per-epoch
+        # subsampling only re-draws the keep mask over the fixed index array
+        base_flat, base_sid = self._flatten_corpus(seqs, subsample=False)
+        if len(base_flat) < 2:
+            return self
+        keep_p = self._keep_probs(base_flat) if self.sampling > 0 else None
+        corpus_dev = None  # device-resident when subsampling is off
+        for epoch in range(self.epochs):
+            if corpus_dev is None or keep_p is not None:
+                if keep_p is not None:
+                    m = self._np_rng.random(len(base_flat)) < keep_p
+                    flat, sid = base_flat[m], base_sid[m]
+                else:
+                    flat, sid = base_flat, base_sid
+                if len(flat) < 2:
+                    continue
+                corpus_dev = (jnp.asarray(flat), jnp.asarray(sid))
+            n = int(corpus_dev[0].shape[0])
+            T = max(1, (n + B - 1) // B)
+            # bucketed scan length: token-count jitter between subsampled
+            # epochs must not recompile the epoch graph (padded steps lr=0)
+            T2 = pad_scan_length(T)
+            # shuffled center positions; wrap to fill the last batch
+            perm = self._np_rng.permutation(n)
+            pos = np.resize(perm, T2 * B).reshape(T2, B).astype(np.int32)
+            # linear decay normalized by SEEN (post-filter) tokens so the lr
+            # actually reaches min_learning_rate by the last epoch
+            frac = np.minimum(
+                1.0, (epoch + np.arange(T2) * B / n) / self.epochs)
+            lrs = np.maximum(self.min_learning_rate,
+                             self.learning_rate * (1.0 - frac))
+            lrs[T:] = 0.0
+            rng, k = jax.random.split(rng)
+            syn0, syn1neg, _loss = runner(
+                syn0, syn1neg, corpus_dev[0], corpus_dev[1],
+                jnp.asarray(pos), jnp.asarray(lrs, jnp.float32), k)
+        table.syn0 = syn0
+        table.syn1neg = syn1neg
         return self
 
 

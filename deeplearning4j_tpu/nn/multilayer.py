@@ -320,6 +320,203 @@ class MultiLayerNetwork:
             self.epoch_count += 1
         return self
 
+    # ------------------------------------------------------------------
+    # Device-resident epoch training (one dispatch per epoch)
+    # ------------------------------------------------------------------
+    def fit_scan(self, data, epochs: int = 1):
+        """Stack the dataset's batches into [T, ...] device arrays and
+        `lax.scan` the train step — ONE device dispatch per epoch instead of
+        one per batch. This matters whenever per-dispatch latency is
+        comparable to per-step compute: small models, or remote-tunnel
+        backends where each call pays RPC latency. All batches must share
+        shapes (use a uniform-batch iterator or drop the ragged tail).
+
+        TBPTT series are scanned over (series, chunk): hidden state flows
+        between a series' chunks and resets at series boundaries; a ragged
+        final chunk is padded to the chunk length under a zero label-mask
+        (exact — padded steps contribute no loss and no gradient).
+        Equivalent math to `fit()` (reference `MultiLayerNetwork.fit`
+        /`doTruncatedBPTT`, MultiLayerNetwork.java:947/:1119), rebatched
+        for the accelerator. Line-search optimizers (CG/LBFGS) are
+        inherently per-batch sequential and fall back to the fit() loop."""
+        from .conf import OptimizationAlgorithm as OA
+
+        if self.params is None:
+            self.init()
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            # delegate to fit() so epoch listeners/epoch_count behave the
+            # same on this path (and generic iterables survive multi-epoch)
+            from ..datasets.iterators import ListDataSetIterator
+            if isinstance(data, DataSet):
+                data = ListDataSetIterator([data])
+            elif not isinstance(data, DataSetIterator):
+                data = ListDataSetIterator(list(data))
+            return self.fit(data, epochs=epochs)
+        if isinstance(data, DataSet):
+            batches = [data]
+        elif isinstance(data, DataSetIterator):
+            data.reset()
+            batches = []
+            while data.has_next():
+                batches.append(data.next())
+        else:
+            batches = list(data)
+        if not batches:
+            return self
+        shapes = {tuple(np.asarray(b.features).shape) for b in batches}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"fit_scan needs uniform batch shapes, got {sorted(shapes)}; "
+                "pad or drop the ragged tail (ArrayDataSetIterator drops it "
+                "with drop_last=True) or use fit()")
+        xs = np.stack([np.asarray(b.features) for b in batches])
+        ys = np.stack([np.asarray(b.labels) for b in batches])
+
+        def stack_masks(ms, name):
+            have = [m is not None for m in ms]
+            if not any(have):
+                return None
+            if not all(have):
+                raise ValueError(
+                    f"fit_scan needs {name} on every batch or on none "
+                    f"(got a mix); mask the full dataset or use fit()")
+            return np.stack([np.asarray(m) for m in ms])
+
+        fmask = stack_masks([b.features_mask for b in batches],
+                            "features_mask")
+        lmask = stack_masks([b.labels_mask for b in batches], "labels_mask")
+
+        return self.fit_scan_arrays(xs, ys, fmask, lmask, epochs=epochs)
+
+    def fit_scan_arrays(self, xs, ys, fmask=None, lmask=None,
+                        epochs: int = 1):
+        """fit_scan on pre-stacked [T, batch, ...] arrays. Pass
+        device-resident arrays (jax.device_put once) to avoid re-paying the
+        host->device transfer on every call — on remote-tunnel backends the
+        link is the bottleneck, not the math."""
+        from .conf import OptimizationAlgorithm as OA
+
+        if self.params is None:
+            self.init()
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                "fit_scan_arrays supports SGD-updater training only; "
+                "line-search optimizers (CG/LBFGS) are per-batch sequential "
+                "— use fit()")
+        tbptt = (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                 and xs.ndim >= 4)
+        firsts = None
+        xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+        fm_d = jnp.asarray(fmask) if fmask is not None else None
+        lm_d = jnp.asarray(lmask) if lmask is not None else None
+        if tbptt:
+            # device-side chunking: keeps pre-transferred inputs resident
+            L = self.conf.tbptt_fwd_length
+            B, T_time = xs_d.shape[1], xs_d.shape[2]
+            pad = (-T_time) % L
+            if pad:
+                if lm_d is None:
+                    lm_d = jnp.ones(ys_d.shape[:3], jnp.float32)
+                pad3 = lambda a: jnp.pad(a, [(0, 0), (0, 0), (0, pad)]
+                                         + [(0, 0)] * (a.ndim - 3))
+                xs_d, ys_d, lm_d = pad3(xs_d), pad3(ys_d), pad3(lm_d)
+                if fm_d is not None:
+                    fm_d = pad3(fm_d)
+            nc = xs_d.shape[2] // L
+
+            def chunked(a):
+                # [S, B, nc*L, ...] -> [S*nc, B, L, ...]
+                a = a.reshape((a.shape[0], a.shape[1], nc, L) + a.shape[3:])
+                a = jnp.moveaxis(a, 2, 1)
+                return a.reshape((a.shape[0] * nc, a.shape[2], L)
+                                 + a.shape[4:])
+
+            xs_d, ys_d = chunked(xs_d), chunked(ys_d)
+            fm_d = chunked(fm_d) if fm_d is not None else None
+            lm_d = chunked(lm_d) if lm_d is not None else None
+            firsts = np.zeros(int(xs_d.shape[0]), np.float32)
+            firsts[::nc] = 1.0
+            carries0 = self._zero_carries(int(B), xs_d.dtype)
+        key = (tuple(xs_d.shape), tuple(ys_d.shape), fm_d is not None,
+               lm_d is not None, tbptt)
+        cache = self.__dict__.setdefault("_scan_epoch_cache", {})
+        epoch_fn = cache.get(key)
+        if epoch_fn is None:
+            epoch_fn = cache[key] = self._make_scan_epoch(
+                fm_d is not None, lm_d is not None, tbptt)
+        fs_d = jnp.asarray(firsts) if tbptt else None
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            self._rng, k = jax.random.split(self._rng)
+            (self.params, self.state, self.updater_state,
+             scores) = epoch_fn(
+                self.params, self.state, self.updater_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                xs_d, ys_d, fm_d, lm_d, fs_d,
+                carries0 if tbptt else (), k)
+            self.last_batch_size = int(xs_d.shape[1])
+            n_steps = int(xs_d.shape[0])
+            if self.listeners:
+                host_scores = np.asarray(scores)
+                for i in range(n_steps):
+                    self._score = host_scores[i]
+                    self.iteration_count += 1
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self.iteration_count)
+            else:
+                self._score = scores[-1]
+                self.iteration_count += n_steps
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch_count += 1
+        return self
+
+    def _make_scan_epoch(self, has_fmask, has_lmask, tbptt):
+        step_fn = self.train_step_fn
+
+        @jax.jit
+        def epoch(params, state, opt_state, step0, xs, ys, fmask, lmask,
+                  firsts, carries0, rng):
+            keys = jax.random.split(rng, xs.shape[0])
+
+            def body(carry, inp):
+                params, state, opt, step, carries = carry
+                x, y, fm, lm, first, k = inp
+                if tbptt:
+                    carries = jax.tree_util.tree_map(
+                        lambda c: c * (1.0 - first), carries)
+                    params, state, opt, score, carries = step_fn(
+                        params, state, opt, step, x, y, k, fm, lm, carries)
+                else:
+                    params, state, opt, score = step_fn(
+                        params, state, opt, step, x, y, k, fm, lm)
+                return (params, state, opt, step + 1, carries), score
+
+            inp = (xs, ys,
+                   fmask if has_fmask else jnp.zeros((xs.shape[0],)),
+                   lmask if has_lmask else jnp.zeros((xs.shape[0],)),
+                   firsts if tbptt else jnp.zeros((xs.shape[0],)), keys)
+            if not has_fmask or not has_lmask or not tbptt:
+                # replace unused per-step slots with cheap dummies; the body
+                # must see None for absent masks (static branch in loss)
+                def body_wrap(carry, inp):
+                    x, y, fm, lm, first, k = inp
+                    return body(carry, (x, y,
+                                        fm if has_fmask else None,
+                                        lm if has_lmask else None,
+                                        first, k))
+                run_body = body_wrap
+            else:
+                run_body = body
+            (params, state, opt, _step, _carries), scores = jax.lax.scan(
+                run_body, (params, state, opt_state, step0, carries0), inp)
+            return params, state, opt, scores
+
+        return epoch
+
     @functools.cached_property
     def _line_solver(self):
         from ..optimize.solvers import LineSearchSolver

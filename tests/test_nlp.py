@@ -253,3 +253,22 @@ def test_basic_label_aware_iterator():
     docs = list(it)
     assert [d.labels[0] for d in docs] == ["DOC_0", "DOC_1"]
     assert it.get_labels_source().size() == 2
+
+
+def test_word2vec_tiny_vocab_stays_finite():
+    """Regression: batched-sum SGD on a tiny vocab (high per-row duplication
+    within a batch) must not diverge — centers-per-step is capped by vocab
+    size in the SGNS corpus fast path."""
+    sents = []
+    for i in range(1500):
+        a = ["cat", "dog", "pet", "fur"][i % 4]
+        b = ["car", "road", "wheel", "drive"][i % 4]
+        sents.append(f"{a} {a} pet animal fur tail")
+        sents.append(f"{b} {b} vehicle road wheel engine")
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                   layer_size=48, window_size=3, negative=5, epochs=2,
+                   min_word_frequency=1, seed=11)
+    w2v.fit()
+    m = w2v.lookup_table.vectors_matrix()
+    assert np.all(np.isfinite(m)), "embeddings diverged"
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "wheel") + 0.1
