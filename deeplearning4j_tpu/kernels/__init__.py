@@ -1,0 +1,34 @@
+"""Pallas TPU kernel layer — the accelerated-helper tier (SURVEY.md §2.3/§7.7).
+
+Reference analog: `deeplearning4j-cuda` — cuDNN-backed implementations of the
+layer-helper SPI, probed at runtime by layer impls
+(`CudnnConvolutionHelper.java:49`). Here the "hand kernel" tier is Pallas:
+layers/ops call these when `pallas_supported()` and fall back to the plain
+XLA path otherwise; every kernel is validated against its jnp reference and
+gradient-checked (the `CuDNNGradientChecks` pattern,
+`deeplearning4j-cuda/src/test/.../CuDNNGradientChecks.java`).
+
+Kernels run compiled on TPU and in interpreter mode on CPU (so the same
+tests cover both, like the reference's backend-profile test matrix).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["pallas_supported", "flash_attention", "fused_bn_relu",
+           "bn_relu_inference"]
+
+
+def pallas_supported() -> bool:
+    """True when the Pallas kernel tier should be used: a TPU backend is
+    live and kernels are not disabled via DL4J_TPU_DISABLE_PALLAS."""
+    flag = os.environ.get("DL4J_TPU_DISABLE_PALLAS", "").strip().lower()
+    if flag not in ("", "0", "false", "no", "off"):
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+from .attention import flash_attention                      # noqa: E402
+from .bn_relu import bn_relu_inference, fused_bn_relu      # noqa: E402

@@ -1,0 +1,188 @@
+"""Flash attention — blockwise streaming-softmax attention as a Pallas
+TPU kernel.
+
+The single-device building block of the long-context stack: exact softmax
+attention in O(T) memory, with the K/V stream tiled through VMEM and the
+running (m, l, acc) statistics held on-chip instead of materializing the
+[T, S] score matrix in HBM. The ring layer
+(`parallel/ring_attention.py`) runs the same math across devices; this
+kernel is the within-device tier (the reference's analog of a cuDNN
+helper, `CudnnConvolutionHelper.java:49` pattern — selected when
+available, plain-XLA `blockwise_attention` otherwise).
+
+Grid layout: (batch, q_blocks, kv_blocks) — the kv axis is innermost so
+the (m, l, acc) VMEM scratch carries across kv steps of one q block
+(TPU grids are sequential). Causal masking and ragged (non-multiple)
+sequence lengths are handled with index masks.
+
+Backward pass: the kernel is wrapped in `jax.custom_vjp`; the backward
+recomputes attention with the plain-jnp reference (rematerialization —
+O(T*S) transient inside XLA, which is the standard memory/compute trade
+at this tier; the ring layer keeps the global memory O(T/devices)).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "attention_reference"]
+
+_NEG_INF = float("-inf")
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Plain softmax attention oracle. q: [B, T, D], k/v: [B, S, D]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        T, S = logits.shape[-2], logits.shape[-1]
+        qi = jnp.arange(T)[:, None]
+        ki = jnp.arange(S)[None, :]
+        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _make_kernel(causal: bool, sm_scale: float, bq: int, bk: int,
+                 s_len: int):
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # causal: a kv block strictly above the q block's diagonal is dead
+        live = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+
+        @pl.when(live)
+        def _():
+            q_blk = q_ref[0]                    # [bq, D]
+            k_blk = k_ref[0]                    # [bk, D]
+            v_blk = v_ref[0]                    # [bk, D]
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            kv_idx = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            mask = kv_idx < s_len               # ragged tail
+            if causal:
+                q_idx = i * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                mask = mask & (kv_idx <= q_idx)
+            s = jnp.where(mask, s, _NEG_INF)
+
+            m_prev = m_ref[:]                   # [bq, 128] lane-replicated
+            m_cur = jnp.max(s, axis=-1, keepdims=True)     # [bq, 1]
+            m_new = jnp.maximum(m_prev, m_cur)
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            p = jnp.exp(s - m_safe[:, :1])
+            p = jnp.where(mask, p, 0.0)
+            corr = jnp.where(jnp.isneginf(m_prev), 0.0,
+                             jnp.exp(m_prev - m_safe))
+            m_ref[:] = m_new
+            l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * corr[:, :1] + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == pl.num_programs(2) - 1)
+        def _():
+            o_ref[0] = (acc_ref[:]
+                        / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+                            o_ref.dtype)
+
+    return kernel
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, T, D = q.shape
+    S = k.shape[1]
+    bq = min(block_q, _round_up(T, 8))
+    bk = min(block_k, _round_up(S, 8))
+    Tp, Sp = _round_up(T, bq), _round_up(S, bk)
+    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0)))
+    grid = (B, Tp // bq, Sp // bk)
+    kernel = _make_kernel(causal, sm_scale, bq, bk, S)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Tp, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denom l
+            pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :T]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blockwise flash attention. q: [B, T, D], k/v: [B, S, D].
+
+    Compiled Pallas on TPU; `interpret=True` (automatic off-TPU) runs the
+    identical kernel through the Pallas interpreter so CPU CI validates the
+    same code path the TPU executes."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # TPU lowering needs sublane-dim blocks in multiples of 8
+    block_q = max(8, _round_up(int(block_q), 8))
+    block_k = max(8, _round_up(int(block_k), 8))
+    return _flash(q, k, v, bool(causal), float(sm_scale), block_q,
+                  block_k, bool(interpret))

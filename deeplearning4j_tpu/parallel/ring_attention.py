@@ -30,15 +30,11 @@ __all__ = ["blockwise_attention", "ring_self_attention",
 
 
 def local_attention_reference(q, k, v, causal: bool = False):
-    """Plain softmax attention (the correctness oracle). q,k,v: [B, T, H]."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    logits = jnp.einsum("bqh,bkh->bqk", q, k) * scale
-    if causal:
-        T, S = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((T, S), bool))
-        logits = jnp.where(mask, logits, -jnp.inf)
-    w = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bqk,bkh->bqh", w, v)
+    """Plain softmax attention (the correctness oracle). q,k,v: [B, T, H].
+    Single oracle shared with the kernel tier (kernels/attention.py)."""
+    from ..kernels.attention import attention_reference
+
+    return attention_reference(q, k, v, causal=causal)
 
 
 def _fold_block(q, k_blk, v_blk, m, l, o, scale, blk_mask=None):
@@ -63,7 +59,14 @@ def _fold_block(q, k_blk, v_blk, m, l, o, scale, blk_mask=None):
 def blockwise_attention(q, k, v, block_size: int = 128):
     """Single-device blockwise (memory-efficient) attention over K/V blocks —
     identical math to the ring, with the ring permute replaced by a scan over
-    local blocks."""
+    local blocks. On TPU this dispatches to the Pallas flash kernel
+    (`kernels/attention.py`, the accelerated-helper tier); the jnp scan
+    below is the reference path (and what CPU CI exercises)."""
+    from ..kernels import flash_attention, pallas_supported
+
+    if pallas_supported():
+        return flash_attention(q, k, v, block_q=block_size,
+                               block_k=block_size)
     B, T, H = q.shape
     S = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(H, q.dtype))

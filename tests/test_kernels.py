@@ -1,0 +1,183 @@
+"""Pallas kernel tier validation — the `CuDNNGradientChecks` pattern
+(`deeplearning4j-cuda/src/test/.../gradientcheck/CuDNNGradientChecks.java`):
+every accelerated kernel is checked against the plain-jnp reference
+implementation and numerically gradient-checked. Run in Pallas interpreter
+mode on the CPU mesh (same kernel code path the TPU compiles).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.kernels import flash_attention, fused_bn_relu
+from deeplearning4j_tpu.kernels.attention import attention_reference
+from deeplearning4j_tpu.kernels.bn_relu import bn_relu_reference
+
+
+def _qkv(B=2, T=96, S=80, D=64, dtype=np.float32, seed=0):
+    r = np.random.default_rng(seed)
+    q = jnp.asarray(r.normal(size=(B, T, D)).astype(dtype))
+    k = jnp.asarray(r.normal(size=(B, S, D)).astype(dtype))
+    v = jnp.asarray(r.normal(size=(B, S, D)).astype(dtype))
+    return q, k, v
+
+
+# ------------------------- flash attention --------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    q, k, v = _qkv(T=64, S=64)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("T,S", [(96, 80), (33, 17), (128, 5)])
+def test_flash_attention_ragged_lengths(T, S):
+    """Sequence lengths that don't divide the block size are masked, not
+    silently padded into the softmax."""
+    q, k, v = _qkv(T=T, S=S)
+    out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_causal_ragged():
+    q, k, v = _qkv(T=50, S=50)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grad_matches_reference_grad():
+    q, k, v = _qkv(T=48, S=48, D=32)
+
+    def loss_k(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True, block_q=16,
+                                       block_k=16, interpret=True) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(attention_reference(q_, k_, v_, causal=True) ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_numeric_gradcheck():
+    """Central-difference check against the actual kernel forward,
+    GradientCheckUtil.checkGradients:75 style. The kernel accumulates in
+    f32, so step/tolerance are f32-scaled."""
+    q, k, v = _qkv(B=1, T=8, S=8, D=4, dtype=np.float32)
+
+    def loss(q_):
+        return float(jnp.sum(
+            flash_attention(q_, k, v, block_q=8, block_k=8,
+                            interpret=True) ** 2))
+
+    g = jax.grad(lambda q_: jnp.sum(
+        flash_attention(q_, k, v, block_q=8, block_k=8,
+                        interpret=True) ** 2))(q)
+    g = np.asarray(g)
+    qn = np.asarray(q)
+    eps = 1e-2
+    r = np.random.default_rng(3)
+    for _ in range(8):
+        i = tuple(r.integers(0, s) for s in qn.shape)
+        qp, qm = qn.copy(), qn.copy()
+        qp[i] += eps
+        qm[i] -= eps
+        num = (loss(jnp.asarray(qp)) - loss(jnp.asarray(qm))) / (2 * eps)
+        rel = abs(num - g[i]) / max(abs(num) + abs(g[i]), 1e-9)
+        assert rel < 2e-2, (i, num, g[i])
+
+
+def test_flash_attention_bf16():
+    q, k, v = _qkv(T=64, S=64)
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), block_q=32, block_k=32,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+# ------------------------- fused BN + ReLU --------------------------------
+
+def test_fused_bn_relu_matches_reference():
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(64, 48)).astype(np.float32))
+    g = jnp.asarray(r.normal(size=(48,)).astype(np.float32))
+    b = jnp.asarray(r.normal(size=(48,)).astype(np.float32))
+    y, mean, var = fused_bn_relu(x, g, b, interpret=True)
+    yr, mr, vr = bn_relu_reference(x, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(vr), rtol=1e-5)
+
+
+def test_fused_bn_relu_nhwc():
+    r = np.random.default_rng(1)
+    x = jnp.asarray(r.normal(size=(4, 6, 6, 24)).astype(np.float32))
+    g = jnp.ones((24,), jnp.float32)
+    b = jnp.zeros((24,), jnp.float32)
+    y, mean, var = fused_bn_relu(x, g, b, interpret=True)
+    yr, mr, vr = bn_relu_reference(x.reshape(-1, 24), g, b)
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 24),
+                               np.asarray(yr), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_bn_relu_grad_matches_reference():
+    r = np.random.default_rng(2)
+    x = jnp.asarray(r.normal(size=(32, 20)).astype(np.float32))
+    g = jnp.asarray(1.0 + 0.1 * r.normal(size=(20,)).astype(np.float32))
+    b = jnp.asarray(0.1 * r.normal(size=(20,)).astype(np.float32))
+    w = jnp.asarray(r.normal(size=(32, 20)).astype(np.float32))
+
+    def loss_k(x_, g_, b_):
+        y, _, _ = fused_bn_relu(x_, g_, b_, interpret=True)
+        return jnp.sum(y * w)
+
+    def loss_ref(x_, g_, b_):
+        y, _, _ = bn_relu_reference(x_, g_, b_)
+        return jnp.sum(y * w)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b)
+    for a, c in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_bn_relu_numeric_gradcheck():
+    r = np.random.default_rng(4)
+    x = jnp.asarray(r.normal(size=(12, 8)).astype(np.float32))
+    g = jnp.asarray(1.0 + 0.1 * r.normal(size=(8,)).astype(np.float32))
+    b = jnp.asarray(0.1 * r.normal(size=(8,)).astype(np.float32))
+
+    def loss(x_):
+        y, _, _ = fused_bn_relu(x_, g, b, interpret=True)
+        return jnp.sum(y ** 2)
+
+    grad = np.asarray(jax.grad(loss)(x))
+    xn = np.asarray(x)
+    eps = 1e-2   # kernel computes in f32; f32-scaled step/tolerance
+    for _ in range(8):
+        i = tuple(r.integers(0, s) for s in xn.shape)
+        xp, xm = xn.copy(), xn.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        num = (float(loss(jnp.asarray(xp))) - float(loss(jnp.asarray(xm)))) \
+            / (2 * eps)
+        rel = abs(num - grad[i]) / max(abs(num) + abs(grad[i]), 1e-9)
+        assert rel < 2e-2, (i, num, grad[i])
