@@ -73,7 +73,14 @@ def _download(url: str, dest: str, timeout: int = 60) -> bool:
 
 def read_idx(path: str) -> np.ndarray:
     """Parse an IDX file (optionally .gz): magic = 0x00 0x00 <dtype> <ndim>.
-    MNIST uses dtype 0x08 (ubyte) with ndim 1 (labels) or 3 (images)."""
+    MNIST uses dtype 0x08 (ubyte) with ndim 1 (labels) or 3 (images).
+    Uncompressed files go through the native C++ decoder when available
+    (`native/dl4j_native.cpp`, the reference's `datasets/mnist/` reader
+    analog)."""
+    if not path.endswith(".gz"):
+        from ..native import idx_read_native, native_available
+        if native_available():
+            return idx_read_native(path)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         data = f.read()

@@ -1,0 +1,247 @@
+"""Native runtime loader — builds and binds libdl4j_native (C++17).
+
+The reference's data plane is native (DataVec record readers, the custom
+MNIST binary reader under `datasets/mnist/`, MagicQueue prefetch); here the
+equivalents live in `dl4j_native.cpp`, compiled on first use with the host
+toolchain and bound with ctypes (no pybind11 in the image). Everything has
+a pure-Python fallback — `native_available()` gates the fast path, exactly
+like the reference's runtime cuDNN-helper probe
+(`ConvolutionLayer.initializeHelper` pattern).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["native_available", "lib", "idx_read_native", "csv_read_native",
+           "u8_to_f32", "PrefetchRing"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "dl4j_native.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    cache = os.environ.get(
+        "DL4J_TPU_NATIVE_DIR",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu", "lib"))
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libdl4j_native.so")
+
+
+def _build(dest: str) -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", dest]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=180)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info("native build unavailable: %s", e)
+        return False
+    if out.returncode != 0:
+        log.warning("native build failed:\n%s", out.stderr[-2000:])
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL):
+    c_char_p, c_int, c_i64 = ctypes.c_char_p, ctypes.c_int, ctypes.c_int64
+    u8_p = ctypes.POINTER(ctypes.c_uint8)
+    f32_p = ctypes.POINTER(ctypes.c_float)
+    i64_p = ctypes.POINTER(c_i64)
+    lib.idx_header.argtypes = [c_char_p, ctypes.POINTER(c_int),
+                               ctypes.POINTER(c_int), i64_p]
+    lib.idx_header.restype = c_int
+    lib.idx_payload.argtypes = [c_char_p, u8_p, c_i64]
+    lib.idx_payload.restype = c_i64
+    lib.u8_to_f32.argtypes = [u8_p, f32_p, c_i64, ctypes.c_float,
+                              ctypes.c_float]
+    lib.u8_to_f32.restype = None
+    lib.u8_binarize_f32.argtypes = [u8_p, f32_p, c_i64, c_int]
+    lib.u8_binarize_f32.restype = None
+    lib.csv_shape.argtypes = [c_char_p, c_int, i64_p, i64_p]
+    lib.csv_shape.restype = c_int
+    lib.csv_parse_f32.argtypes = [c_char_p, c_int, f32_p, c_i64, c_i64]
+    lib.csv_parse_f32.restype = c_i64
+    lib.ring_open.argtypes = [c_char_p, c_i64, c_i64, c_i64, c_i64, c_int]
+    lib.ring_open.restype = ctypes.c_void_p
+    lib.ring_next.argtypes = [ctypes.c_void_p, u8_p]
+    lib.ring_next.restype = c_i64
+    lib.ring_close.argtypes = [ctypes.c_void_p]
+    lib.ring_close.restype = None
+    lib.ring_error.argtypes = [ctypes.c_void_p]
+    lib.ring_error.restype = c_int
+    lib.dl4j_native_abi.argtypes = []
+    lib.dl4j_native_abi.restype = c_int
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("DL4J_TPU_DISABLE_NATIVE", "").strip().lower() \
+                in ("1", "true", "yes", "on"):
+            return None
+        path = _lib_path()
+        src_mtime = os.path.getmtime(_SRC)
+        if not os.path.exists(path) or os.path.getmtime(path) < src_mtime:
+            if not _build(path):
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+            _bind(lib)
+            if lib.dl4j_native_abi() != 1:
+                return None
+            _LIB = lib
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+            return None
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def lib() -> ctypes.CDLL:
+    l = _load()
+    if l is None:
+        raise RuntimeError("dl4j_native is not available on this host")
+    return l
+
+
+# ---------------------------------------------------------------------------
+# numpy-facing wrappers
+# ---------------------------------------------------------------------------
+
+_IDX_DTYPES = {0x08: (np.uint8, 1), 0x09: (np.int8, 1), 0x0B: (">i2", 2),
+               0x0C: (">i4", 4), 0x0D: (">f4", 4), 0x0E: (">f8", 8)}
+
+
+def idx_read_native(path: str) -> np.ndarray:
+    """Read an (uncompressed) IDX file via the native decoder."""
+    l = lib()
+    dtype = ctypes.c_int()
+    ndim = ctypes.c_int()
+    dims = (ctypes.c_int64 * 8)()
+    rc = l.idx_header(path.encode(), ctypes.byref(dtype), ctypes.byref(ndim),
+                      dims)
+    if rc != 0:
+        raise ValueError(f"bad IDX file {path!r} (rc={rc})")
+    if dtype.value not in _IDX_DTYPES:
+        raise ValueError(f"unknown IDX dtype 0x{dtype.value:02x}")
+    np_dtype, itemsize = _IDX_DTYPES[dtype.value]
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape)) * itemsize
+    # validate the untrusted header against the real file size BEFORE
+    # allocating (a corrupt header must not drive a multi-TiB np.empty),
+    # and reject trailing garbage like the pure-Python parser does
+    expected = 4 + 4 * ndim.value + n
+    actual = os.path.getsize(path)
+    if actual != expected:
+        raise ValueError(
+            f"{path}: payload size {actual - 4 - 4 * ndim.value} != shape "
+            f"{shape} ({n} bytes expected)")
+    buf = np.empty(n, np.uint8)
+    got = l.idx_payload(path.encode(),
+                        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                        n)
+    if got != n:
+        raise ValueError(f"IDX payload short read: {got} != {n}")
+    return buf.view(np_dtype).reshape(shape)
+
+
+def csv_read_native(path: str, skip_rows: int = 0) -> np.ndarray:
+    """Parse a numeric CSV into a float32 [rows, cols] array."""
+    l = lib()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = l.csv_shape(path.encode(), skip_rows, ctypes.byref(rows),
+                     ctypes.byref(cols))
+    if rc != 0:
+        raise ValueError(f"cannot read CSV {path!r} (rc={rc})")
+    out = np.empty((rows.value, cols.value), np.float32)
+    got = l.csv_parse_f32(path.encode(), skip_rows,
+                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                          rows.value, cols.value)
+    if got != rows.value:
+        raise ValueError(f"CSV short parse: {got} != {rows.value}")
+    return out
+
+
+def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0,
+              shift: float = 0.0, binarize: bool = False,
+              threshold: int = 30) -> np.ndarray:
+    """Normalize a uint8 payload to float32 natively (reference
+    MnistDataFetcher normalization/binarize flags)."""
+    l = lib()
+    src = np.ascontiguousarray(src, np.uint8)
+    out = np.empty(src.shape, np.float32)
+    sp = src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    dp = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    if binarize:
+        l.u8_binarize_f32(sp, dp, src.size, threshold)
+    else:
+        l.u8_to_f32(sp, dp, src.size, scale, shift)
+    return out
+
+
+class PrefetchRing:
+    """Background C++ thread streaming fixed-size records from a binary file
+    into a ring of pre-decoded batch buffers (MagicQueue analog). Iterate
+    with next_batch() until it returns None (epoch end)."""
+
+    def __init__(self, path: str, record_bytes: int, total_records: int,
+                 batch_records: int, header_bytes: int = 0, slots: int = 3):
+        self._lib = lib()
+        self.record_bytes = int(record_bytes)
+        self.batch_records = int(batch_records)
+        self._h = self._lib.ring_open(
+            path.encode(), header_bytes, record_bytes, total_records,
+            batch_records, slots)
+        if not self._h:
+            raise OSError(f"cannot open {path!r}")
+        self._buf = np.empty(self.batch_records * self.record_bytes,
+                             np.uint8)
+
+    def next_batch(self) -> Optional[np.ndarray]:
+        got = self._lib.ring_next(
+            self._h,
+            self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if got == 0:
+            return None
+        if got < 0:
+            raise IOError(f"prefetch ring error {got}")
+        n = int(got)
+        return (self._buf[:n * self.record_bytes]
+                .reshape(n, self.record_bytes).copy())
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ring_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
