@@ -131,9 +131,15 @@ class MnistDataFetcher:
         prefix = "train" if self.train else "test"
         images = read_idx(self._file(f"{prefix}_images"))
         labels = read_idx(self._file(f"{prefix}_labels"))
-        x = images.reshape(images.shape[0], -1).astype(np.float32) / 255.0
-        if self.binarize:
-            x = (x > 0.5).astype(np.float32)
+        from ..native import native_available, u8_to_f32
+        flat = images.reshape(images.shape[0], -1)
+        if native_available() and flat.dtype == np.uint8:
+            # native normalize/binarize; threshold 127 == (x/255 > 0.5)
+            x = u8_to_f32(flat, binarize=self.binarize, threshold=127)
+        else:
+            x = flat.astype(np.float32) / 255.0
+            if self.binarize:
+                x = (x > 0.5).astype(np.float32)
         y = np.eye(10, dtype=np.float32)[labels.astype(np.int64)]
         if self.shuffle:
             rng = np.random.default_rng(self.seed)

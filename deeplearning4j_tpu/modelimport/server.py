@@ -1,0 +1,184 @@
+"""Keras backend server (deeplearning4j-keras analog, SURVEY.md §2.8).
+
+Reference: a py4j `GatewayServer` (`keras/Server.java:15-18`) exposing
+`DeepLearning4jEntryPoint.fit()` (`DeepLearning4jEntryPoint.java:21`) —
+reads a Keras HDF5 model + a directory of HDF5 minibatches and runs
+`multiLayerNetwork.fit` (:33), with `HDF5MiniBatchDataSetIterator` and
+`NDArrayHDF5Reader` doing the IO.
+
+Here the Python<->JVM gateway is replaced with a plain HTTP JSON API
+(stdlib http.server — the TPU host process *is* Python, so the server's
+job is remote control, not language bridging):
+
+    POST /fit    {"model": "/path/model.h5", "data_dir": "...",
+                  "epochs": 1, "save_to": "..."}   -> trains
+    POST /output {"model": "/path/model.h5", "features": [[...]]}
+                                                   -> predictions
+    GET  /ping                                     -> {"status": "ok"}
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..datasets.iterators import DataSet, DataSetIterator
+
+__all__ = ["NDArrayHDF5Reader", "HDF5MiniBatchDataSetIterator",
+           "DeepLearning4jEntryPoint", "KerasBackendServer"]
+
+
+class NDArrayHDF5Reader:
+    """Read one dataset from an HDF5 file into numpy
+    (`NDArrayHDF5Reader.java` analog)."""
+
+    def read(self, path: str, dataset: str = "data") -> np.ndarray:
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            if dataset not in f:
+                # fall back to the first dataset in the file
+                keys = list(f.keys())
+                if not keys:
+                    raise KeyError(f"{path}: empty HDF5 file")
+                dataset = keys[0]
+            return np.asarray(f[dataset])
+
+
+class HDF5MiniBatchDataSetIterator(DataSetIterator):
+    """Iterates a directory of HDF5 minibatch files
+    (`HDF5MiniBatchDataSetIterator.java` analog). Each file holds
+    `features` and `labels` datasets; files iterate in sorted order."""
+
+    def __init__(self, data_dir: str, features_key: str = "features",
+                 labels_key: str = "labels"):
+        self.data_dir = data_dir
+        self.features_key = features_key
+        self.labels_key = labels_key
+        self._files = sorted(
+            os.path.join(data_dir, f) for f in os.listdir(data_dir)
+            if f.endswith((".h5", ".hdf5")))
+        if not self._files:
+            raise FileNotFoundError(f"no .h5 minibatches in {data_dir!r}")
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._files)
+
+    def next(self) -> DataSet:
+        import h5py
+
+        path = self._files[self._pos]
+        self._pos += 1
+        with h5py.File(path, "r") as f:
+            x = np.asarray(f[self.features_key], np.float32)
+            y = np.asarray(f[self.labels_key], np.float32)
+        return DataSet(x, y)
+
+    def batch(self) -> int:
+        import h5py
+
+        with h5py.File(self._files[0], "r") as f:
+            return int(f[self.features_key].shape[0])
+
+
+class DeepLearning4jEntryPoint:
+    """The fit/predict entry point (`DeepLearning4jEntryPoint.java:21`).
+    A single lock serializes model loading and training: the server is
+    threaded for request handling, but two concurrent fits on one network
+    would interleave weight updates."""
+
+    def __init__(self):
+        self._models: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _load_locked(self, model_path: str):
+        if model_path not in self._models:
+            from .keras import import_keras_sequential_model_and_weights
+            self._models[model_path] = \
+                import_keras_sequential_model_and_weights(model_path)
+        return self._models[model_path]
+
+    def fit(self, model_path: str, data_dir: str, epochs: int = 1,
+            save_to: Optional[str] = None) -> Dict:
+        with self._lock:
+            net = self._load_locked(model_path)
+            it = HDF5MiniBatchDataSetIterator(data_dir)
+            net.fit(it, epochs=int(epochs))
+            if save_to:
+                from ..util.serializer import ModelSerializer
+                ModelSerializer.write_model(net, save_to)
+            return {"status": "ok", "score": float(net.score()),
+                    "iterations": int(net.iteration_count)}
+
+    def output(self, model_path: str, features: np.ndarray) -> np.ndarray:
+        with self._lock:
+            net = self._load_locked(model_path)
+            return np.asarray(net.output(np.asarray(features, np.float32)))
+
+
+class KerasBackendServer:
+    """HTTP control server wrapping the entry point (`Server.java:15`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        entry = self.entry_point = DeepLearning4jEntryPoint()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _reply(self, code: int, payload: Dict):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/ping":
+                    self._reply(200, {"status": "ok"})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/fit":
+                        out = entry.fit(body["model"], body["data_dir"],
+                                        body.get("epochs", 1),
+                                        body.get("save_to"))
+                        self._reply(200, out)
+                    elif self.path == "/output":
+                        preds = entry.output(
+                            body["model"], np.asarray(body["features"],
+                                                      np.float32))
+                        self._reply(200, {"output": preds.tolist()})
+                    else:
+                        self._reply(404, {"error": "unknown path"})
+                except Exception as e:   # surface errors to the client
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "KerasBackendServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -41,18 +41,30 @@ def _lib_path() -> str:
 
 
 def _build(dest: str) -> bool:
+    # build to a temp file in the same dir, then atomically os.replace:
+    # concurrent builders don't corrupt each other, and a long-running
+    # process with the old .so mmapped keeps its (unlinked) inode instead
+    # of taking SIGBUS from an in-place truncate
+    tmp = f"{dest}.build.{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", dest]
+           _SRC, "-o", tmp]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=180)
+        if out.returncode != 0:
+            log.warning("native build failed:\n%s", out.stderr[-2000:])
+            return False
+        os.replace(tmp, dest)
+        return True
     except (OSError, subprocess.TimeoutExpired) as e:
         log.info("native build unavailable: %s", e)
         return False
-    if out.returncode != 0:
-        log.warning("native build failed:\n%s", out.stderr[-2000:])
-        return False
-    return True
+    finally:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
 
 
 def _bind(lib: ctypes.CDLL):
@@ -74,6 +86,11 @@ def _bind(lib: ctypes.CDLL):
     lib.csv_shape.restype = c_int
     lib.csv_parse_f32.argtypes = [c_char_p, c_int, f32_p, c_i64, c_i64]
     lib.csv_parse_f32.restype = c_i64
+    lib.csv_parse_alloc.argtypes = [c_char_p, c_int,
+                                    ctypes.POINTER(f32_p), i64_p, i64_p]
+    lib.csv_parse_alloc.restype = c_i64
+    lib.csv_free.argtypes = [f32_p]
+    lib.csv_free.restype = None
     lib.ring_open.argtypes = [c_char_p, c_i64, c_i64, c_i64, c_i64, c_int]
     lib.ring_open.restype = ctypes.c_void_p
     lib.ring_next.argtypes = [ctypes.c_void_p, u8_p]
@@ -95,19 +112,20 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("DL4J_TPU_DISABLE_NATIVE", "").strip().lower() \
                 in ("1", "true", "yes", "on"):
             return None
-        path = _lib_path()
-        src_mtime = os.path.getmtime(_SRC)
-        if not os.path.exists(path) or os.path.getmtime(path) < src_mtime:
-            if not _build(path):
-                return None
         try:
+            path = _lib_path()
+            src_mtime = os.path.getmtime(_SRC)
+            if not os.path.exists(path) \
+                    or os.path.getmtime(path) < src_mtime:
+                if not _build(path):
+                    return None
             lib = ctypes.CDLL(path)
             _bind(lib)
             if lib.dl4j_native_abi() != 1:
                 return None
             _LIB = lib
-        except OSError as e:
-            log.warning("native load failed: %s", e)
+        except Exception as e:   # ANY probe failure degrades to pure Python
+            log.info("native tier unavailable: %s", e)
             return None
         return _LIB
 
@@ -165,20 +183,27 @@ def idx_read_native(path: str) -> np.ndarray:
 
 
 def csv_read_native(path: str, skip_rows: int = 0) -> np.ndarray:
-    """Parse a numeric CSV into a float32 [rows, cols] array."""
+    """Parse a numeric CSV into a float32 [rows, cols] array (single file
+    read; ragged rows are an error, matching the numpy fallback)."""
     l = lib()
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()
-    rc = l.csv_shape(path.encode(), skip_rows, ctypes.byref(rows),
-                     ctypes.byref(cols))
+    buf = ctypes.POINTER(ctypes.c_float)()
+    rc = l.csv_parse_alloc(path.encode(), skip_rows, ctypes.byref(buf),
+                           ctypes.byref(rows), ctypes.byref(cols))
+    if rc == -5:
+        raise ValueError(f"{path}: ragged CSV (rows have differing field "
+                         "counts)")
     if rc != 0:
         raise ValueError(f"cannot read CSV {path!r} (rc={rc})")
-    out = np.empty((rows.value, cols.value), np.float32)
-    got = l.csv_parse_f32(path.encode(), skip_rows,
-                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                          rows.value, cols.value)
-    if got != rows.value:
-        raise ValueError(f"CSV short parse: {got} != {rows.value}")
+    try:
+        n = rows.value * cols.value
+        out = np.ctypeslib.as_array(buf, shape=(n,)).astype(
+            np.float32, copy=True).reshape(rows.value, cols.value) \
+            if n else np.empty((rows.value, cols.value), np.float32)
+    finally:
+        if n:
+            l.csv_free(buf)
     return out
 
 

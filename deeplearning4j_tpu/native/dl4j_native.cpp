@@ -18,6 +18,11 @@
 //     MagicQueue/AsyncDataSetIterator analog, file-backed)
 //
 // Build: g++ -O3 -std=c++17 -shared -fPIC dl4j_native.cpp -o libdl4j_native.so
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE  // strtof_l / newlocale
+#endif
+#include <locale.h>
+
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
@@ -101,14 +106,26 @@ void u8_binarize_f32(const unsigned char* src, float* dst, int64_t n,
 // CSV float parser
 // ---------------------------------------------------------------------------
 
+// Locale-pinned strtof: the caller's process may run under a comma-decimal
+// locale (de_DE etc.), where plain strtof("1.5") would stop at the '.'.
+static float strtof_c(const char* s, char** end) {
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return strtof_l(s, end, c_loc);
+}
+
 // Count data rows and columns. Rows = newline-terminated non-empty lines
-// minus `skip_rows`. Columns = fields in the first counted row. Returns 0 on
-// success.
+// minus `skip_rows`. Columns = fields in the first counted row; a later row
+// with a different field count is an error (-5, matching the loud failure
+// of the numpy fallback on ragged CSVs). Returns 0 on success.
 int csv_shape(const char* path, int skip_rows, int64_t* rows, int64_t* cols) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
   std::fseek(f, 0, SEEK_END);
   long sz = std::ftell(f);
+  if (sz < 0) {  // non-seekable (FIFO etc.) — fail cleanly, no OOB write
+    std::fclose(f);
+    return -1;
+  }
   std::fseek(f, 0, SEEK_SET);
   std::vector<char> buf((size_t)sz + 1);
   if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
@@ -134,10 +151,13 @@ int csv_shape(const char* path, int skip_rows, int64_t* rows, int64_t* cols) {
       if (skipped < skip_rows) {
         skipped++;
       } else {
+        int64_t this_c = 1;
+        for (const char* q = p; q < line_end; q++)
+          if (*q == ',') this_c++;
         if (r == 0) {
-          c = 1;
-          for (const char* q = p; q < line_end; q++)
-            if (*q == ',') c++;
+          c = this_c;
+        } else if (this_c != c) {
+          return -5;  // ragged row
         }
         r++;
       }
@@ -149,6 +169,99 @@ int csv_shape(const char* path, int skip_rows, int64_t* rows, int64_t* cols) {
   return 0;
 }
 
+// One-read variant: slurp the file once, derive the shape and parse from
+// the same buffer, returning a malloc'd matrix the caller frees with
+// csv_free. Returns 0 on success (rows/cols/out filled) or <0 (-5 ragged).
+int64_t csv_parse_alloc(const char* path, int skip_rows, float** out,
+                        int64_t* rows, int64_t* cols) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) {  // non-seekable (FIFO etc.) — fail cleanly, no OOB write
+    std::fclose(f);
+    return -1;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> buf((size_t)sz + 1);
+  if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
+    std::fclose(f);
+    return -2;
+  }
+  std::fclose(f);
+  buf[(size_t)sz] = '\0';
+
+  auto line_empty = [](const char* a, const char* b) {
+    for (const char* q = a; q < b; q++)
+      if (*q != ' ' && *q != '\r' && *q != '\t') return false;
+    return true;
+  };
+  // pass 1: shape (over the in-memory buffer)
+  int64_t r = 0, c = 0;
+  {
+    const char* p = buf.data();
+    const char* end = p + sz;
+    int skipped = 0;
+    while (p < end) {
+      const char* le = (const char*)memchr(p, '\n', (size_t)(end - p));
+      if (!le) le = end;
+      if (!line_empty(p, le)) {
+        if (skipped < skip_rows) {
+          skipped++;
+        } else {
+          int64_t tc = 1;
+          for (const char* q = p; q < le; q++)
+            if (*q == ',') tc++;
+          if (r == 0) c = tc;
+          else if (tc != c) return -5;  // ragged row
+          r++;
+        }
+      }
+      p = le + 1;
+    }
+  }
+  float* m = (float*)std::malloc((size_t)(r * c) * sizeof(float));
+  if (!m && r * c > 0) return -6;
+  // pass 2: parse (same buffer, no second read)
+  {
+    char* p = buf.data();
+    char* end = p + sz;
+    int skipped = 0;
+    int64_t rr = 0;
+    while (p < end && rr < r) {
+      char* le = (char*)memchr(p, '\n', (size_t)(end - p));
+      if (!le) le = end;
+      if (!line_empty(p, le)) {
+        if (skipped < skip_rows) {
+          skipped++;
+        } else {
+          char saved = *le;
+          *le = '\0';
+          char* q = p;
+          for (int64_t cc = 0; cc < c; cc++) {
+            char* next = nullptr;
+            float v = strtof_c(q, &next);
+            if (next == q) v = 0.0f;
+            m[rr * c + cc] = v;
+            q = next;
+            while (q < le && *q != ',') q++;
+            if (q < le) q++;
+          }
+          *le = saved;
+          rr++;
+        }
+      }
+      p = le + 1;
+    }
+  }
+  *out = m;
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+void csv_free(float* p) { std::free(p); }
+
 // Parse into caller-allocated out[rows*cols] (row-major f32). Non-numeric
 // fields parse as 0. Returns number of rows parsed or <0.
 int64_t csv_parse_f32(const char* path, int skip_rows, float* out,
@@ -157,6 +270,10 @@ int64_t csv_parse_f32(const char* path, int skip_rows, float* out,
   if (!f) return -1;
   std::fseek(f, 0, SEEK_END);
   long sz = std::ftell(f);
+  if (sz < 0) {  // non-seekable (FIFO etc.) — fail cleanly, no OOB write
+    std::fclose(f);
+    return -1;
+  }
   std::fseek(f, 0, SEEK_SET);
   std::vector<char> buf((size_t)sz + 1);
   if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
@@ -187,7 +304,7 @@ int64_t csv_parse_f32(const char* path, int skip_rows, float* out,
         char* q = p;
         for (int64_t cc = 0; cc < cols; cc++) {
           char* next = nullptr;
-          float v = strtof(q, &next);
+          float v = strtof_c(q, &next);
           if (next == q) v = 0.0f;  // non-numeric field
           out[r * cols + cc] = v;
           q = next;

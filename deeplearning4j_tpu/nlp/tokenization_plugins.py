@@ -1,0 +1,391 @@
+"""Language/pipeline tokenizer plugins.
+
+Reference analogs (SURVEY.md §2.5):
+  * deeplearning4j-nlp-uima — UIMA pipeline of annotators
+    (`text/annotator/{SentenceAnnotator,TokenizerAnnotator,PoStagger,
+    StemmerAnnotator}.java`): here `SentenceAnnotator` (rule-based sentence
+    segmentation), `PorterStemmer`/`StemmerPreprocessor` (real Porter
+    algorithm, replacing the Snowball stemmer UIMA wraps), `PosTagger`
+    (lightweight lexical/suffix tagger), composed by
+    `PipelineTokenizerFactory` — same plugin surface, no UIMA runtime.
+  * deeplearning4j-nlp-japanese — vendored Kuromoji
+    (`com/atilika/kuromoji/**`): `JapaneseTokenizer` segments by script
+    class (kanji/hiragana/katakana/latin runs, with hiragana particles
+    split off). A dictionary-less approximation of Kuromoji granularity —
+    the plugin surface and factory contract match; swap in a dictionary
+    tokenizer via the same TokenizerFactory SPI for morphological accuracy.
+  * deeplearning4j-nlp-korean — KoreanTokenizer over twitter-korean-text:
+    here whitespace segmentation plus splitting common josa (particles)
+    off Hangul tokens.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from .tokenization import Tokenizer, TokenizerFactory
+
+__all__ = [
+    "PorterStemmer", "StemmerPreprocessor", "SentenceAnnotator",
+    "PosTagger", "PipelineTokenizerFactory", "JapaneseTokenizer",
+    "JapaneseTokenizerFactory", "KoreanTokenizer", "KoreanTokenizerFactory",
+]
+
+
+# ---------------------------------------------------------------------------
+# Porter stemmer (the UIMA StemmerAnnotator capability)
+# ---------------------------------------------------------------------------
+
+class PorterStemmer:
+    """Porter 1980 stemming algorithm (full 5-step rule set)."""
+
+    _VOWELS = set("aeiou")
+
+    def _cons(self, w: str, i: int) -> bool:
+        c = w[i]
+        if c in self._VOWELS:
+            return False
+        if c == "y":
+            return i == 0 or not self._cons(w, i - 1)
+        return True
+
+    def _measure(self, w: str) -> int:
+        """Number of VC sequences in the [C](VC)^m[V] decomposition."""
+        m, i, n = 0, 0, len(w)
+        while i < n and self._cons(w, i):
+            i += 1
+        while i < n:
+            while i < n and not self._cons(w, i):
+                i += 1
+            if i >= n:
+                break
+            m += 1
+            while i < n and self._cons(w, i):
+                i += 1
+        return m
+
+    def _has_vowel(self, w: str) -> bool:
+        return any(not self._cons(w, i) for i in range(len(w)))
+
+    def _double_cons(self, w: str) -> bool:
+        return (len(w) >= 2 and w[-1] == w[-2] and self._cons(w, len(w) - 1))
+
+    def _cvc(self, w: str) -> bool:
+        if len(w) < 3:
+            return False
+        return (self._cons(w, len(w) - 3)
+                and not self._cons(w, len(w) - 2)
+                and self._cons(w, len(w) - 1)
+                and w[-1] not in "wxy")
+
+    def stem(self, word: str) -> str:
+        w = word.lower()
+        if len(w) <= 2:
+            return w
+        # step 1a
+        if w.endswith("sses"):
+            w = w[:-2]
+        elif w.endswith("ies"):
+            w = w[:-2]
+        elif not w.endswith("ss") and w.endswith("s"):
+            w = w[:-1]
+        # step 1b
+        if w.endswith("eed"):
+            if self._measure(w[:-3]) > 0:
+                w = w[:-1]
+        else:
+            flag = False
+            if w.endswith("ed") and self._has_vowel(w[:-2]):
+                w, flag = w[:-2], True
+            elif w.endswith("ing") and self._has_vowel(w[:-3]):
+                w, flag = w[:-3], True
+            if flag:
+                if w.endswith(("at", "bl", "iz")):
+                    w += "e"
+                elif self._double_cons(w) and w[-1] not in "lsz":
+                    w = w[:-1]
+                elif self._measure(w) == 1 and self._cvc(w):
+                    w += "e"
+        # step 1c
+        if w.endswith("y") and self._has_vowel(w[:-1]):
+            w = w[:-1] + "i"
+        # step 2
+        for suf, rep in (("ational", "ate"), ("tional", "tion"),
+                         ("enci", "ence"), ("anci", "ance"), ("izer", "ize"),
+                         ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+                         ("eli", "e"), ("ousli", "ous"), ("ization", "ize"),
+                         ("ation", "ate"), ("ator", "ate"), ("alism", "al"),
+                         ("iveness", "ive"), ("fulness", "ful"),
+                         ("ousness", "ous"), ("aliti", "al"),
+                         ("iviti", "ive"), ("biliti", "ble")):
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if self._measure(stem) > 0:
+                    w = stem + rep
+                break
+        # step 3
+        for suf, rep in (("icate", "ic"), ("ative", ""), ("alize", "al"),
+                         ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
+                         ("ness", "")):
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if self._measure(stem) > 0:
+                    w = stem + rep
+                break
+        # step 4
+        for suf in ("al", "ance", "ence", "er", "ic", "able", "ible", "ant",
+                    "ement", "ment", "ent", "ou", "ism", "ate", "iti",
+                    "ous", "ive", "ize"):
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if self._measure(stem) > 1:
+                    w = stem
+                break
+            if suf == "ent" and w.endswith("ion"):
+                stem = w[:-3]
+                if stem and stem[-1] in "st" and self._measure(stem) > 1:
+                    w = stem
+                break
+        # step 5a
+        if w.endswith("e"):
+            stem = w[:-1]
+            m = self._measure(stem)
+            if m > 1 or (m == 1 and not self._cvc(stem)):
+                w = stem
+        # step 5b
+        if self._double_cons(w) and w.endswith("l") \
+                and self._measure(w) > 1:
+            w = w[:-1]
+        return w
+
+
+class StemmerPreprocessor:
+    """Token preprocessor applying the Porter stemmer (StemmerAnnotator)."""
+
+    def __init__(self):
+        self._stemmer = PorterStemmer()
+
+    def pre_process(self, token: str) -> str:
+        return self._stemmer.stem(token)
+
+
+# ---------------------------------------------------------------------------
+# Sentence segmentation (SentenceAnnotator / UimaSentenceIterator)
+# ---------------------------------------------------------------------------
+
+class SentenceAnnotator:
+    """Rule-based sentence segmentation: terminal punctuation followed by
+    whitespace + capital/digit/quote, with an abbreviation guard."""
+
+    _ABBREV = {"mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs",
+               "etc", "e.g", "i.e", "fig", "no", "vol", "inc", "ltd", "co"}
+    _SPLIT = re.compile(r"(?<=[.!?])[\")\]]*\s+(?=[\"'(\[]?[A-Z0-9])")
+
+    def annotate(self, text: str) -> List[str]:
+        parts = self._SPLIT.split(text.strip())
+        out: List[str] = []
+        for p in parts:
+            p = p.strip()
+            if not p:
+                continue
+            if out:
+                prev = out[-1]
+                last_word = prev.rstrip(".").rsplit(" ", 1)[-1].lower()
+                if last_word in self._ABBREV and prev.endswith("."):
+                    out[-1] = prev + " " + p
+                    continue
+            out.append(p)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Lightweight POS tagging (PoStagger capability)
+# ---------------------------------------------------------------------------
+
+class PosTagger:
+    """Lexicon+suffix part-of-speech tagger over the Penn tag subset the
+    reference pipeline exposes (DT/IN/PRP/CC/MD/VB*/NN*/JJ/RB/CD)."""
+
+    _LEX = {
+        "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
+        "of": "IN", "in": "IN", "on": "IN", "at": "IN", "by": "IN",
+        "for": "IN", "with": "IN", "to": "TO", "from": "IN",
+        "i": "PRP", "you": "PRP", "he": "PRP", "she": "PRP", "it": "PRP",
+        "we": "PRP", "they": "PRP", "and": "CC", "or": "CC", "but": "CC",
+        "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD",
+        "be": "VB", "been": "VBN", "have": "VBP", "has": "VBZ",
+        "can": "MD", "will": "MD", "would": "MD", "should": "MD",
+        "not": "RB", "very": "RB",
+    }
+
+    def tag(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
+        out = []
+        for t in tokens:
+            low = t.lower()
+            if low in self._LEX:
+                tag = self._LEX[low]
+            elif re.fullmatch(r"[-+]?\d[\d,.]*", t):
+                tag = "CD"
+            elif low.endswith("ing"):
+                tag = "VBG"
+            elif low.endswith("ed"):
+                tag = "VBD"
+            elif low.endswith("ly"):
+                tag = "RB"
+            elif low.endswith(("ous", "ful", "ive", "able", "al", "ic")):
+                tag = "JJ"
+            elif low.endswith("s") and not low.endswith("ss"):
+                tag = "NNS"
+            elif t[:1].isupper():
+                tag = "NNP"
+            else:
+                tag = "NN"
+            out.append((t, tag))
+        return out
+
+
+class PipelineTokenizerFactory(TokenizerFactory):
+    """UIMA-pipeline analog: sentence segmentation -> tokenization ->
+    optional stemming, behind the standard TokenizerFactory SPI (the
+    `UimaTokenizerFactory` role)."""
+
+    _TOKEN = re.compile(r"[A-Za-z0-9']+")
+
+    def __init__(self, stem: bool = False, lowercase: bool = True):
+        self._pre = None
+        self.stem = stem
+        self.lowercase = lowercase
+        self._sentences = SentenceAnnotator()
+        self._stemmer = PorterStemmer()
+
+    def create(self, text: str) -> Tokenizer:
+        toks: List[str] = []
+        for sent in self._sentences.annotate(text):
+            for t in self._TOKEN.findall(sent):
+                if self.lowercase:
+                    t = t.lower()
+                if self.stem:
+                    t = self._stemmer.stem(t)
+                toks.append(t)
+        return Tokenizer(toks, self._pre)
+
+
+# ---------------------------------------------------------------------------
+# Japanese (Kuromoji-analog surface)
+# ---------------------------------------------------------------------------
+
+_HIRAGANA = (0x3041, 0x309F)
+_KATAKANA = (0x30A0, 0x30FF)
+_KANJI = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF))
+_CHOON = 0x30FC  # prolonged sound mark, stays with katakana runs
+
+# common hiragana particles split off as their own tokens (は/が/を/に/…)
+_JA_PARTICLES = {"は", "が", "を", "に", "で", "と", "へ", "も", "の",
+                 "や", "か", "ね", "よ", "から", "まで", "より"}
+
+
+def _script(ch: str) -> str:
+    cp = ord(ch)
+    if _HIRAGANA[0] <= cp <= _HIRAGANA[1]:
+        return "hira"
+    if _KATAKANA[0] <= cp <= _KATAKANA[1] or cp == _CHOON:
+        return "kata"
+    if any(lo <= cp <= hi for lo, hi in _KANJI):
+        return "kanji"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+class JapaneseTokenizer(Tokenizer):
+    """Script-run segmentation with particle splitting (see module
+    docstring for scope vs the vendored Kuromoji)."""
+
+    def __init__(self, text: str, preprocessor=None):
+        runs: List[str] = []
+        cur, cur_script = [], None
+        for ch in text:
+            s = _script(ch)
+            if s in ("space", "punct"):
+                if cur:
+                    runs.append("".join(cur))
+                    cur, cur_script = [], None
+                continue
+            if s != cur_script and cur:
+                runs.append("".join(cur))
+                cur = []
+            cur.append(ch)
+            cur_script = s
+        if cur:
+            runs.append("".join(cur))
+        # split leading particles off hiragana runs (the most common
+        # content-word boundary in kana text)
+        toks: List[str] = []
+        for run in runs:
+            if _script(run[0]) == "hira" and len(run) > 1:
+                matched = False
+                for plen in (2, 1):
+                    if len(run) > plen and run[:plen] in _JA_PARTICLES:
+                        toks.append(run[:plen])
+                        toks.append(run[plen:])
+                        matched = True
+                        break
+                if not matched:
+                    toks.append(run)
+            else:
+                toks.append(run)
+        super().__init__(toks, preprocessor)
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    def __init__(self):
+        self._pre = None
+
+    def create(self, text: str) -> Tokenizer:
+        return JapaneseTokenizer(text, self._pre)
+
+
+# ---------------------------------------------------------------------------
+# Korean (twitter-korean-text-analog surface)
+# ---------------------------------------------------------------------------
+
+_KO_JOSA = ("은", "는", "이", "가", "을", "를", "의", "에", "와", "과",
+            "도", "만", "으로", "로", "에서", "에게", "까지", "부터",
+            "입니다", "습니다")
+
+
+def _is_hangul(ch: str) -> bool:
+    return 0xAC00 <= ord(ch) <= 0xD7A3
+
+
+class KoreanTokenizer(Tokenizer):
+    """Whitespace segmentation + splitting common josa (particles) off
+    Hangul tokens."""
+
+    def __init__(self, text: str, preprocessor=None):
+        toks: List[str] = []
+        for raw in re.findall(r"\S+", text):
+            word = raw.strip("\"'.,!?()[]{}:;")
+            if not word:
+                continue
+            if all(_is_hangul(c) for c in word) and len(word) > 1:
+                for josa in sorted(_KO_JOSA, key=len, reverse=True):
+                    if word.endswith(josa) and len(word) > len(josa):
+                        toks.append(word[: -len(josa)])
+                        toks.append(josa)
+                        break
+                else:
+                    toks.append(word)
+            else:
+                toks.append(word)
+        super().__init__(toks, preprocessor)
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    def __init__(self):
+        self._pre = None
+
+    def create(self, text: str) -> Tokenizer:
+        return KoreanTokenizer(text, self._pre)

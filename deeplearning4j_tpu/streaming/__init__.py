@@ -1,0 +1,204 @@
+"""Streaming ingest + online serving (dl4j-streaming analog).
+
+Reference (SURVEY.md §2.4): `streaming/kafka/NDArrayKafkaClient.java`,
+`NDArrayPublisher/Consumer`, `routes/DL4jServeRouteBuilder.java:27` —
+Camel routes that consume serialized arrays from Kafka, restore a model
+with ModelSerializer, run `output()`, and publish the result.
+
+TPU-native shape: the broker is replaced with length-prefixed numpy (.npy)
+messages over TCP sockets — no Kafka/Camel runtime. `NDArrayConsumer`
+listens, `NDArrayPublisher` connects and sends, and `InferenceRoute` wires
+consumer -> restored model -> publisher exactly like DL4jServeRouteBuilder
+(`configure:50`). The host-side serving plane stays off the device; each
+batch is one `model.output` call on the accelerator.
+"""
+from __future__ import annotations
+
+import io
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["NDArraySerde", "NDArrayConsumer", "NDArrayPublisher",
+           "InferenceRoute"]
+
+
+class NDArraySerde:
+    """Array <-> bytes via the self-describing .npy format (the role of the
+    reference's Nd4j binary serde in `NDArrayKafkaClient`)."""
+
+    @staticmethod
+    def to_bytes(arr: np.ndarray) -> bytes:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr), allow_pickle=False)
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> np.ndarray:
+        return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+def _send_msg(sock: socket.socket, payload: bytes):
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    parts = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            return None
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, 8)
+    if head is None:
+        return None
+    (ln,) = struct.unpack(">Q", head)
+    return _recv_exact(sock, ln)
+
+
+class NDArrayConsumer:
+    """Listens on a TCP port; received arrays are queued for `take()`
+    (reference NDArrayConsumer over a Kafka topic)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 queue_size: int = 64):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(8)
+        self.host, self.port = self._srv.getsockname()
+        self._q: queue.Queue = queue.Queue(queue_size)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            # daemon reader per connection; no bookkeeping — readers exit
+            # with their socket, and close() unblocks them via shutdown
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: socket.socket):
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except OSError:
+                    return
+                if msg is None:
+                    return
+                self._q.put(NDArraySerde.from_bytes(msg))
+
+    def take(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NDArrayPublisher:
+    """Connects to a consumer and publishes arrays (reference
+    NDArrayPublisher)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def publish(self, arr: np.ndarray):
+        _send_msg(self._sock, NDArraySerde.to_bytes(arr))
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class InferenceRoute:
+    """Serve route (`DL4jServeRouteBuilder.configure:50`): consume input
+    arrays, run the restored model's `output()`, publish predictions.
+
+    Use `start()` for the background-thread route (consumer port ->
+    downstream publisher), or call `process(arr)` synchronously."""
+
+    def __init__(self, model_or_path, listen_port: int = 0,
+                 forward: Optional[NDArrayPublisher] = None,
+                 before_processing=None):
+        if isinstance(model_or_path, str):
+            from ..util.serializer import ModelSerializer
+            self.model = ModelSerializer.restore(model_or_path)
+        else:
+            self.model = model_or_path
+        self.consumer = NDArrayConsumer(port=listen_port)
+        self.forward = forward
+        self.before_processing = before_processing
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.consumer.port
+
+    def process(self, arr: np.ndarray) -> np.ndarray:
+        if self.before_processing is not None:
+            arr = self.before_processing(arr)
+        return np.asarray(self.model.output(arr))
+
+    def _loop(self):
+        import logging
+        log = logging.getLogger("deeplearning4j_tpu")
+        while not self._stop.is_set():
+            arr = self.consumer.take(timeout=0.2)
+            if arr is None:
+                continue
+            try:
+                out = self.process(arr)
+                if self.forward is not None:
+                    self.forward.publish(out)
+            except Exception:   # a bad batch must not kill the route
+                log.exception("InferenceRoute: dropping failed batch "
+                              "(shape=%s)", getattr(arr, "shape", None))
+
+    def start(self) -> "InferenceRoute":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self.consumer.close()

@@ -1,0 +1,259 @@
+"""Tests for the auxiliary reference surfaces added in round 2:
+streaming (dl4j-streaming analog), Keras backend server (2.8), language /
+pipeline tokenizer plugins (UIMA/JP/KR), and provisioning (aws analog).
+"""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.tokenization_plugins import (
+    JapaneseTokenizerFactory, KoreanTokenizerFactory,
+    PipelineTokenizerFactory, PorterStemmer, PosTagger, SentenceAnnotator)
+from deeplearning4j_tpu.provision import (HostProvisioner, StorageUploader,
+                                          TpuClusterSetup, TpuPodSpec)
+from deeplearning4j_tpu.streaming import (InferenceRoute, NDArrayConsumer,
+                                          NDArrayPublisher, NDArraySerde)
+
+
+# --------------------------- tokenizer plugins ----------------------------
+
+def test_porter_stemmer_canonical_cases():
+    s = PorterStemmer()
+    # canonical examples from Porter's paper
+    for word, stem in [("caresses", "caress"), ("ponies", "poni"),
+                       ("feed", "feed"), ("agreed", "agre"),
+                       ("plastered", "plaster"), ("motoring", "motor"),
+                       ("sing", "sing"), ("conflated", "conflat"),
+                       ("troubling", "troubl"), ("happy", "happi"),
+                       ("relational", "relat"), ("conditional", "condit"),
+                       ("vietnamization", "vietnam"),
+                       ("predication", "predic"),
+                       ("hopefulness", "hope"), ("formaliti", "formal"),
+                       ("triplicate", "triplic"), ("formative", "form"),
+                       ("electrical", "electr"),
+                       ("adjustable", "adjust"), ("effective", "effect"),
+                       ("probate", "probat"), ("cease", "ceas")]:
+        assert s.stem(word) == stem, (word, s.stem(word), stem)
+
+
+def test_sentence_annotator_splits_and_guards_abbreviations():
+    sa = SentenceAnnotator()
+    out = sa.annotate("Dr. Smith arrived. He sat down! Was it late? Yes.")
+    assert out == ["Dr. Smith arrived.", "He sat down!", "Was it late?",
+                   "Yes."]
+
+
+def test_pos_tagger_basic():
+    tags = dict(PosTagger().tag(
+        ["The", "dog", "quickly", "jumped", "over", "42", "fences"]))
+    assert tags["The"] == "DT"
+    assert tags["quickly"] == "RB"
+    assert tags["jumped"] == "VBD"
+    assert tags["42"] == "CD"
+    assert tags["fences"] == "NNS"
+
+
+def test_pipeline_tokenizer_factory_stems():
+    tf = PipelineTokenizerFactory(stem=True)
+    toks = tf.create("The dogs were running. They jumped!").get_tokens()
+    assert "run" in toks and "jump" in toks and "dog" in toks
+
+
+def test_japanese_tokenizer_script_runs():
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私は東京タワーへ行きます。").get_tokens()
+    # kanji/kana script boundaries + particle splitting
+    assert "私" in toks
+    assert "は" in toks
+    assert "東京" in toks
+    assert "タワー" in toks
+    assert "へ" in toks
+
+
+def test_korean_tokenizer_splits_josa():
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("나는 학교에 갑니다").get_tokens()
+    assert "나" in toks and "는" in toks
+    assert "학교" in toks and "에" in toks
+
+
+def test_plugin_factories_work_with_word2vec_vocab():
+    """Plugin tokenizers satisfy the same SPI the NLP stack consumes."""
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    tf = JapaneseTokenizerFactory()
+    toks = [tf.create("猫は可愛い。犬も可愛い。").get_tokens()]
+    vocab = VocabConstructor(1).build_vocab(iter(toks), iter([[]]))
+    assert vocab.contains_word("猫")
+
+
+# ------------------------------ streaming ---------------------------------
+
+def test_ndarray_serde_roundtrip():
+    a = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    b = NDArraySerde.from_bytes(NDArraySerde.to_bytes(a))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_publisher_consumer_roundtrip():
+    with NDArrayConsumer() as consumer:
+        with NDArrayPublisher(consumer.host, consumer.port) as pub:
+            a = np.arange(12, dtype=np.float32).reshape(3, 4)
+            pub.publish(a)
+            pub.publish(a * 2)
+            got1 = consumer.take(timeout=5)
+            got2 = consumer.take(timeout=5)
+    np.testing.assert_array_equal(got1, a)
+    np.testing.assert_array_equal(got2, a * 2)
+
+
+def _small_net(n_in=6, n_out=3, seed=0):
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_inference_route_serves_model_outputs(tmp_path):
+    """The DL4jServeRouteBuilder flow: serialized model -> route -> consume
+    input arrays -> publish model outputs."""
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+    net = _small_net()
+    path = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, path)
+
+    with NDArrayConsumer() as sink:
+        route = InferenceRoute(path,
+                               forward=NDArrayPublisher(sink.host,
+                                                        sink.port))
+        route.start()
+        try:
+            x = np.random.default_rng(1).normal(size=(5, 6)) \
+                .astype(np.float32)
+            with NDArrayPublisher("127.0.0.1", route.port) as pub:
+                pub.publish(x)
+            out = sink.take(timeout=10)
+        finally:
+            route.stop()
+    assert out is not None and out.shape == (5, 3)
+    np.testing.assert_allclose(out, np.asarray(net.output(x)), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+# --------------------------- keras backend server -------------------------
+
+def test_keras_backend_server_fit_and_output(tmp_path):
+    keras = pytest.importorskip("keras")
+    import h5py
+
+    from deeplearning4j_tpu.modelimport.server import KerasBackendServer
+
+    model = keras.Sequential([
+        keras.layers.Input((5,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="adam")
+    mpath = str(tmp_path / "model.h5")
+    model.save(mpath)
+
+    r = np.random.default_rng(0)
+    data_dir = tmp_path / "batches"
+    data_dir.mkdir()
+    for i in range(3):
+        with h5py.File(str(data_dir / f"batch_{i}.h5"), "w") as f:
+            f.create_dataset("features",
+                             data=r.normal(size=(16, 5)).astype(np.float32))
+            f.create_dataset(
+                "labels",
+                data=np.eye(3, dtype=np.float32)[r.integers(0, 3, 16)])
+
+    srv = KerasBackendServer().start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        with urllib.request.urlopen(base + "/ping", timeout=10) as resp:
+            assert json.load(resp)["status"] == "ok"
+        req = urllib.request.Request(
+            base + "/fit",
+            json.dumps({"model": mpath, "data_dir": str(data_dir),
+                        "epochs": 2}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.load(resp)
+        assert out["status"] == "ok" and out["iterations"] == 6
+        req = urllib.request.Request(
+            base + "/output",
+            json.dumps({"model": mpath,
+                        "features": np.zeros((2, 5)).tolist()}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            preds = np.asarray(json.load(resp)["output"])
+        assert preds.shape == (2, 3)
+        np.testing.assert_allclose(preds.sum(1), 1.0, rtol=1e-4)
+    finally:
+        srv.stop()
+
+
+def test_hdf5_minibatch_iterator_requires_files(tmp_path):
+    from deeplearning4j_tpu.modelimport.server import (
+        HDF5MiniBatchDataSetIterator)
+    with pytest.raises(FileNotFoundError):
+        HDF5MiniBatchDataSetIterator(str(tmp_path))
+
+
+# ------------------------------ provisioning ------------------------------
+
+def test_tpu_cluster_setup_commands():
+    spec = TpuPodSpec(name="trainer", zone="us-east5-a",
+                      accelerator_type="v5litepod-16", project="proj",
+                      preemptible=True, tags={"team": "ml"})
+    setup = TpuClusterSetup(spec)
+    create = setup.create_command()
+    assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "trainer" in create and "--zone=us-east5-a" in create
+    assert "--accelerator-type=v5litepod-16" in create
+    assert "--project=proj" in create and "--preemptible" in create
+    assert "--labels=team=ml" in create
+    delete = setup.delete_command()
+    assert "delete" in delete and "--quiet" in delete
+    ssh = setup.ssh_command("hostname", worker="0")
+    assert "--worker=0" in ssh and "--command=hostname" in ssh
+    # dry-run never shells out
+    assert setup.create(dry_run=True) is None
+
+
+def test_host_provisioner_script():
+    prov = HostProvisioner(pip_packages=["jax[tpu]"],
+                           env={"JAX_PLATFORMS": "tpu"},
+                           extra_commands=["echo done"])
+    script = prov.script()
+    assert "pip install --upgrade jax[tpu]" in script
+    assert "JAX_PLATFORMS=tpu" in script
+    assert script.endswith("echo done")
+
+
+def test_storage_uploader_commands():
+    up = StorageUploader()
+    assert up.command("/tmp/f", "gs://b/k")[:2] == ["gsutil", "cp"]
+    assert up.command("/tmp/f", "s3://b/k")[:3] == ["aws", "s3", "cp"]
+    with pytest.raises(ValueError):
+        up.command("/tmp/f", "ftp://x")
+    assert up.upload("/tmp/f", "gs://b/k", dry_run=True) is None
+
+
+def test_storage_url_rewrite():
+    from deeplearning4j_tpu.provision import _to_https
+    assert _to_https("gs://bucket/a/b.txt") == \
+        "https://storage.googleapis.com/bucket/a/b.txt"
+    assert _to_https("s3://bucket/k.bin") == \
+        "https://bucket.s3.amazonaws.com/k.bin"
+    assert _to_https("https://x/y") == "https://x/y"

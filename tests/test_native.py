@@ -181,3 +181,25 @@ def test_native_idx_int32_dtype_matches_python(tmp_path):
         f.write(data.tobytes())
     a = nat.idx_read_native(p)
     assert (np.asarray(a, np.int64) == np.asarray(data, np.int64)).all()
+
+
+@needs_native
+def test_native_csv_rejects_ragged_rows(tmp_path):
+    """Ragged CSVs fail loudly on BOTH paths (numpy fallback raises too)."""
+    p = str(tmp_path / "ragged.csv")
+    with open(p, "w") as f:
+        f.write("1,2\n3,4,5\n")
+    with pytest.raises(ValueError, match="ragged"):
+        CSVRecordReader().read_matrix(p)
+
+
+@needs_native
+def test_native_normalize_matches_python_mnist_semantics():
+    """u8 binarize threshold 127 == the fetcher's (x/255 > 0.5)."""
+    from deeplearning4j_tpu.native import u8_to_f32
+    px = np.arange(256, dtype=np.uint8)
+    nb = u8_to_f32(px, binarize=True, threshold=127)
+    pb = ((px.astype(np.float32) / 255.0) > 0.5).astype(np.float32)
+    assert (nb == pb).all()
+    nn = u8_to_f32(px)
+    np.testing.assert_allclose(nn, px.astype(np.float32) / 255.0, rtol=1e-6)
