@@ -12,7 +12,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Evaluation", "ConfusionMatrix"]
+__all__ = ["Evaluation", "ConfusionMatrix", "Prediction"]
 
 
 class ConfusionMatrix:
@@ -39,6 +39,22 @@ class ConfusionMatrix:
         return "\n".join(lines)
 
 
+class Prediction:
+    """One example's outcome + its metadata (reference
+    `eval/meta/Prediction.java`)."""
+
+    __slots__ = ("actual", "predicted", "meta")
+
+    def __init__(self, actual: int, predicted: int, meta):
+        self.actual = actual
+        self.predicted = predicted
+        self.meta = meta
+
+    def __repr__(self):
+        return (f"Prediction(actual={self.actual}, "
+                f"predicted={self.predicted}, meta={self.meta!r})")
+
+
 class Evaluation:
     def __init__(self, num_classes: Optional[int] = None,
                  labels: Optional[Sequence[str]] = None, top_n: int = 1):
@@ -50,6 +66,9 @@ class Evaluation:
         self.confusion: Optional[ConfusionMatrix] = None
         self.top_n_correct = 0
         self.top_n_total = 0
+        # per-example metadata attribution (reference eval/meta/ —
+        # Prediction records linking outcomes back to example metadata)
+        self.predictions: list = []
 
     # ------------------------------------------------------------------
     def _ensure(self, c: int):
@@ -71,10 +90,13 @@ class Evaluation:
             return (arr > 0.5).astype(np.int64)
         return arr.astype(np.int64)
 
-    def eval(self, labels, predictions, mask: Optional[np.ndarray] = None):
+    def eval(self, labels, predictions, mask: Optional[np.ndarray] = None,
+             meta_data: Optional[Sequence] = None):
         """labels: one-hot [N,C] (or [N,T,C] time series), single-column binary
         [N,1], or index array; predictions: probabilities/scores of same shape.
-        mask: [N] or [N,T]."""
+        mask: [N] or [N,T]. meta_data: optional per-example records (length
+        N) kept with each prediction for error attribution (reference
+        `eval/meta/` — `evaluate(..., List<RecordMetaData>)`)."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim >= 2 and labels.shape[-1] > 1:
@@ -86,10 +108,26 @@ class Evaluation:
         self._ensure(int(c))
         actual = self._to_index(labels).ravel()
         pred = self._to_index(predictions).ravel()
+        if meta_data is not None and labels.ndim >= 3:
+            # time series: each example contributes T per-timestep
+            # predictions — expand per-example metadata to match before any
+            # mask filtering
+            T = labels.shape[1]
+            meta_data = [md for md in meta_data for _ in range(T)]
         if mask is not None:
             m = np.asarray(mask).ravel().astype(bool)
             actual, pred = actual[m], pred[m]
+            if meta_data is not None:
+                meta_data = [md for md, keep in zip(meta_data, m) if keep]
         self.confusion.add(actual, pred)
+        if meta_data is not None:
+            if len(meta_data) != len(actual):
+                raise ValueError(
+                    f"meta_data length {len(meta_data)} != examples "
+                    f"{len(actual)}")
+            self.predictions.extend(
+                Prediction(int(a), int(p), md)
+                for a, p, md in zip(actual, pred, meta_data))
         # top-N accuracy (reference Evaluation topN support)
         if self.top_n > 1 and predictions.ndim >= 2:
             p2 = predictions.reshape(-1, predictions.shape[-1])
@@ -104,6 +142,22 @@ class Evaluation:
     def eval_time_series(self, labels, predictions, labels_mask=None):
         self.eval(labels, predictions, mask=labels_mask)
 
+    # -- per-example attribution (reference EvaluationUtils meta queries) --
+    def get_prediction_errors(self) -> list:
+        """Misclassified examples with their metadata."""
+        return [p for p in self.predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> list:
+        return [p for p in self.predictions if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> list:
+        return [p for p in self.predictions if p.predicted == cls]
+
+    def get_predictions(self, actual: int, predicted: int) -> list:
+        """Examples in one confusion-matrix cell."""
+        return [p for p in self.predictions
+                if p.actual == actual and p.predicted == predicted]
+
     def merge(self, other: "Evaluation"):
         if other.confusion is None:
             return
@@ -111,6 +165,7 @@ class Evaluation:
         self.confusion.matrix += other.confusion.matrix
         self.top_n_correct += other.top_n_correct
         self.top_n_total += other.top_n_total
+        self.predictions.extend(other.predictions)
 
     # ------------------------------------------------------------------
     @property

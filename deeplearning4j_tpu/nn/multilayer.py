@@ -22,6 +22,7 @@ per-layer params, masking, TBPTT hooks, listeners — redesigned TPU-first:
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -35,6 +36,8 @@ from .gradnorm import apply_gradient_normalization
 from .layers.feedforward import BaseOutputLayerConf
 from ..datasets.iterators import ArrayDataSetIterator, DataSet, DataSetIterator
 from ..eval.evaluation import Evaluation
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["MultiLayerNetwork"]
 
@@ -59,6 +62,11 @@ class MultiLayerNetwork:
         self._input_types = None  # input type *to* each layer (post-preprocessor)
         self._rnn_carries = None
         self._pretrained = False
+        # retrace telemetry: every distinct batch signature costs a full
+        # XLA recompile of the train step (SURVEY §5 tracing; the
+        # PerformanceListener-style ETL/iteration split would hide this)
+        self._batch_signatures = set()
+        self.recompile_count = 0
 
     # ------------------------------------------------------------------
     # Initialization
@@ -525,14 +533,30 @@ class MultiLayerNetwork:
             max_line_search_iterations=
             self.conf.conf.max_num_line_search_iterations)
 
+    def _track_signature(self, x, y, fmask, lmask):
+        sig = (tuple(x.shape), tuple(np.shape(y)),
+               None if fmask is None else tuple(fmask.shape),
+               None if lmask is None else tuple(lmask.shape))
+        if sig not in self._batch_signatures:
+            self._batch_signatures.add(sig)
+            self.recompile_count += 1
+            if self.recompile_count == 2:
+                log.info(
+                    "train step retracing for a second batch signature %s — "
+                    "ragged final batches double compile time; use "
+                    "ArrayDataSetIterator(drop_last=True) or pad batches "
+                    "to a fixed size", sig)
+
     def _fit_batch(self, ds: DataSet):
         from .conf import OptimizationAlgorithm as OA
 
         x, y, fmask, lmask = ds.device_tuple()
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and x.ndim == 3):
+            # TBPTT traces per-chunk shapes; _fit_tbptt tracks those
             self._fit_tbptt(x, y, fmask, lmask)
             return
+        self._track_signature(x, y, fmask, lmask)
         self._rng, step_rng = jax.random.split(self._rng)
         if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
             # line-search path (Solver.java -> CG/LBFGS/line GD); the
@@ -566,6 +590,10 @@ class MultiLayerNetwork:
         carries = self._zero_carries(int(x.shape[0]), x.dtype)
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
+            self._track_signature(
+                x[:, sl], y[:, sl],
+                None if fmask is None else fmask[:, sl],
+                None if lmask is None else lmask[:, sl])
             self._rng, step_rng = jax.random.split(self._rng)
             step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
             (self.params, self.state, self.updater_state, score,

@@ -68,7 +68,14 @@ def global_mesh(model_parallel: int = 1, seq_parallel: int = 1,
 def local_batch_slice(global_batch: int) -> slice:
     """This process's slice of a globally-sharded batch (dataset plane: each
     host feeds only its own shard — the reference's Spark exporters did the
-    analogous split with `balancedRandomSplit`)."""
-    per = global_batch // jax.process_count()
+    analogous split with `balancedRandomSplit`). SPMD needs uniform shards,
+    so a non-divisible global batch is an error (pad or drop upstream)
+    rather than a silent loss of the remainder on every host."""
+    count = jax.process_count()
+    if global_batch % count:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count "
+            f"{count}; pad the batch or drop the ragged tail upstream")
+    per = global_batch // count
     i = jax.process_index()
     return slice(i * per, (i + 1) * per)

@@ -1,0 +1,81 @@
+"""Multi-host runtime glue tests (parallel/distributed.py) — single-process
+behaviors for real, multi-process behaviors via monkeypatched process
+topology (the reference tests its cluster path on local-mode Spark the same
+way, BaseSparkTest.java:89).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import deeplearning4j_tpu.parallel.distributed as dist
+from deeplearning4j_tpu.parallel import MeshAxes
+
+
+def test_initialize_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert dist.initialize() is False
+    assert dist.is_multi_host() is False
+    assert dist.process_index() == 0
+
+
+def test_initialize_passes_coordinator(monkeypatch):
+    calls = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        calls.update(addr=coordinator_address, n=num_processes,
+                     pid=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    assert dist.initialize("10.0.0.1:1234", num_processes=4,
+                           process_id=2) is True
+    assert calls == {"addr": "10.0.0.1:1234", "n": 4, "pid": 2}
+
+
+def test_initialize_env_coordinator(monkeypatch):
+    seen = {}
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "envhost:99")
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address=None, **kw: seen.update(
+            addr=coordinator_address))
+    assert dist.initialize() is True
+    assert seen["addr"] == "envhost:99"
+
+
+def test_global_mesh_axes():
+    mesh = dist.global_mesh(model_parallel=2)
+    assert mesh.shape[MeshAxes.MODEL] == 2
+    assert mesh.shape[MeshAxes.DATA] == len(jax.devices()) // 2
+    flat = dist.global_mesh()
+    assert flat.shape[MeshAxes.DATA] == len(jax.devices())
+
+
+def test_global_mesh_rejects_bad_split():
+    with pytest.raises(ValueError, match="not divisible"):
+        dist.global_mesh(model_parallel=3)   # 8 devices % 3 != 0
+
+
+def test_local_batch_slice_single_process():
+    s = dist.local_batch_slice(32)
+    assert (s.start, s.stop) == (0, 32)
+
+
+def test_local_batch_slice_multi_process(monkeypatch):
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 4)
+    shards = []
+    for i in range(4):
+        monkeypatch.setattr(dist.jax, "process_index", lambda i=i: i)
+        shards.append(dist.local_batch_slice(32))
+    # shards partition [0, 32) exactly
+    covered = np.concatenate([np.arange(s.start, s.stop) for s in shards])
+    assert (covered == np.arange(32)).all()
+
+
+def test_local_batch_slice_rejects_ragged(monkeypatch):
+    """A non-divisible global batch must fail loudly, not silently drop
+    the remainder on every host."""
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 4)
+    with pytest.raises(ValueError, match="not divisible"):
+        dist.local_batch_slice(30)

@@ -268,3 +268,92 @@ def test_sharded_checkpoint_manager(tmp_path):
     assert mgr.restore_latest(model2) == 2
     np.testing.assert_allclose(model2.params_flat(), model.params_flat(),
                                rtol=1e-6)
+
+
+def _cnn_model(seed=21):
+    from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                              ConvolutionLayer,
+                                              ConvolutionMode, PoolingType,
+                                              SubsamplingLayer)
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.05))
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    stride=(1, 1), activation="relu",
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_sync_tp_conv_model_matches_single_device():
+    """Tensor-parallel CNN (conv kernels sharded on the output-channel
+    axis, BN params sharded to match): same math as single-device."""
+    r = np.random.default_rng(2)
+    x = r.normal(size=(32, 8, 8, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 32)]
+    ds = DataSet(x, y)
+    single = _cnn_model(seed=21)
+    multi = _cnn_model(seed=21)
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 2, "model": 4}),
+                              mode=TrainingMode.SYNC,
+                              strategy=ShardingStrategy.TENSOR_PARALLEL)
+    for _ in range(3):
+        single.fit(ds)
+        trainer.fit(ds)
+    np.testing.assert_allclose(multi.params_flat(), single.params_flat(),
+                               rtol=5e-4, atol=1e-5)
+
+
+def _lstm_model(seed=23):
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.05))
+            .list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(5, 12))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_sync_tp_lstm_model_matches_single_device():
+    """Tensor-parallel LSTM (gate-block weights sharded on the output
+    axis): same math as single-device."""
+    r = np.random.default_rng(3)
+    idx = r.integers(0, 5, (16, 12))
+    x = np.eye(5, dtype=np.float32)[idx]
+    y = np.eye(5, dtype=np.float32)[np.roll(idx, -1, 1)]
+    ds = DataSet(x, y)
+    single = _lstm_model(seed=23)
+    multi = _lstm_model(seed=23)
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 2, "model": 4}),
+                              mode=TrainingMode.SYNC,
+                              strategy=ShardingStrategy.TENSOR_PARALLEL)
+    for _ in range(3):
+        single.fit(ds)
+        trainer.fit(ds)
+    np.testing.assert_allclose(multi.params_flat(), single.params_flat(),
+                               rtol=5e-4, atol=1e-5)
+
+
+def test_tp_specs_cover_conv_and_lstm_params():
+    """The sharding rules must actually shard conv/LSTM tensors (not fall
+    back to replicated) when the axis divides."""
+    mesh = make_mesh({"data": 2, "model": 4})
+    cnn = _cnn_model()
+    specs = param_specs(cnn.params, ShardingStrategy.TENSOR_PARALLEL, mesh)
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec))
+    sharded = [s for s in flat if any(a is not None for a in s)]
+    assert len(sharded) >= 4, f"conv model barely sharded: {flat}"
+    lstm = _lstm_model()
+    specs = param_specs(lstm.params, ShardingStrategy.TENSOR_PARALLEL, mesh)
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec))
+    sharded = [s for s in flat if any(a is not None for a in s)]
+    assert len(sharded) >= 2, f"lstm model barely sharded: {flat}"

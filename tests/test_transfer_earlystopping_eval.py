@@ -224,3 +224,66 @@ def test_regression_evaluation_masked_timeseries():
     re.eval_time_series(labels, preds, labels_mask=mask)
     assert re.count[0] == 3
     assert re.mean_squared_error(0) == pytest.approx(1.0)
+
+
+def test_eval_meta_data_attribution():
+    """Per-example metadata attribution (reference eval/meta/): errors and
+    confusion cells link back to the example records."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]
+    meta = ["ex0", "ex1", "ex2", "ex3"]
+    ev = Evaluation()
+    ev.eval(labels, preds, meta_data=meta)
+    errors = ev.get_prediction_errors()
+    assert [p.meta for p in errors] == ["ex1"]
+    assert [p.meta for p in ev.get_predictions_by_actual_class(1)] == \
+        ["ex1", "ex3"]
+    assert [p.meta for p in ev.get_predictions(1, 2)] == ["ex1"]
+    other = Evaluation()
+    other.eval(labels[:1], preds[:1], meta_data=["m2"])
+    ev.merge(other)
+    assert len(ev.predictions) == 5
+    with pytest.raises(ValueError):
+        ev.eval(labels, preds, meta_data=["too", "short"])
+
+
+def test_recompile_tracking_counts_batch_signatures():
+    """Weak item: ragged final batches silently double compile time — the
+    net now counts distinct batch signatures (== XLA retraces)."""
+    from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    r = np.random.default_rng(0)
+    x = r.normal(size=(50, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[r.integers(0, 2, 50)]
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ArrayDataSetIterator(x, y, batch_size=16))  # 16,16,16,2 ragged
+    assert net.recompile_count == 2
+    net2 = MultiLayerNetwork(conf).init()
+    net2.fit(ArrayDataSetIterator(x[:48], y[:48], batch_size=16))
+    assert net2.recompile_count == 1
+    net3 = MultiLayerNetwork(conf).init()
+    net3.fit(ArrayDataSetIterator(x, y, batch_size=16, drop_last=True))
+    assert net3.recompile_count == 1
+
+
+def test_eval_meta_data_time_series_expansion():
+    """[N,T,C] labels: per-example metadata expands across timesteps and
+    honors per-timestep masks."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    N, T, C = 2, 3, 2
+    labels = np.eye(C, dtype=np.float32)[[[0, 1, 0], [1, 1, 0]]]
+    preds = np.eye(C, dtype=np.float32)[[[0, 0, 0], [1, 1, 1]]]
+    mask = np.array([[1, 1, 0], [1, 1, 1]], np.float32)
+    ev = Evaluation()
+    ev.eval(labels, preds, mask=mask, meta_data=["a", "b"])
+    assert len(ev.predictions) == 5          # 2 + 3 unmasked timesteps
+    errs = ev.get_prediction_errors()
+    assert [p.meta for p in errs] == ["a", "b"]   # t1 of a, t2 of b
