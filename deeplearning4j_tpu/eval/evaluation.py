@@ -119,12 +119,14 @@ class Evaluation:
             actual, pred = actual[m], pred[m]
             if meta_data is not None:
                 meta_data = [md for md, keep in zip(meta_data, m) if keep]
+        if meta_data is not None and len(meta_data) != len(actual):
+            # validate BEFORE mutating any accumulator so a caught error
+            # leaves the evaluation consistent
+            raise ValueError(
+                f"meta_data length {len(meta_data)} != examples "
+                f"{len(actual)}")
         self.confusion.add(actual, pred)
         if meta_data is not None:
-            if len(meta_data) != len(actual):
-                raise ValueError(
-                    f"meta_data length {len(meta_data)} != examples "
-                    f"{len(actual)}")
             self.predictions.extend(
                 Prediction(int(a), int(p), md)
                 for a, p, md in zip(actual, pred, meta_data))

@@ -182,3 +182,26 @@ class KerasBackendServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+
+
+def main(argv=None):
+    """`python -m deeplearning4j_tpu.modelimport.server --port 8998` —
+    the reference's `Server.main` (py4j gateway on a fixed port)."""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(prog="deeplearning4j_tpu.modelimport.server")
+    ap.add_argument("--port", type=int, default=8998)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    srv = KerasBackendServer(host=args.host, port=args.port).start()
+    print(f"Keras backend server on http://{srv.host}:{srv.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

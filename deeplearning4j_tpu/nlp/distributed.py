@@ -1,0 +1,56 @@
+"""Distributed (multi-chip) embedding training.
+
+Reference analog (SURVEY.md §2.4): dl4j-spark-nlp /
+`SparkSequenceVectors.java` + `SparkWord2Vec.java` — vocab built on the
+driver, per-partition training functions, parameter averaging between
+stages, voting-based parameter-server election (`NetworkOrganizer.java`).
+
+TPU-first redesign: none of that machinery survives. The SGNS fast path
+already computes DENSE matmul gradients (expected negative sampling,
+`embeddings.make_skipgram_corpus_runner`), so multi-chip training is plain
+data parallelism: center POSITIONS shard across the mesh's data axis,
+syn0/syn1neg stay replicated, and XLA inserts the gradient all-reduce over
+ICI — per-step exact synchronous SGD instead of Spark's per-split
+parameter averaging. The host side (vocab build, corpus flattening) runs
+once on each host over its own corpus shard in the multi-host case.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .word2vec import Word2Vec
+
+__all__ = ["DistributedWord2Vec"]
+
+
+class DistributedWord2Vec(Word2Vec):
+    """Word2Vec with the SGNS epoch data-parallel over a mesh axis.
+
+    Same math as single-device Word2Vec (the per-step batch is summed
+    across devices by the XLA-inserted psum, exactly like the batched-sum
+    update on one chip) — verified parameter-identical in
+    tests/test_nlp_distributed.py, the
+    TestCompareParameterAveragingSparkVsSingleMachine.java:44 pattern."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 data_axis: str = "data", **kw):
+        super().__init__(**kw)
+        self.mesh = mesh
+        self.data_axis = data_axis
+
+    def _axis_size(self) -> int:
+        return self.mesh.shape[self.data_axis] if self.mesh is not None else 1
+
+    def _sg_round_batch(self, B: int) -> int:
+        n = self._axis_size()
+        return -(-B // n) * n   # centers-per-step divisible by the axis
+
+    def _sg_place_positions(self, pos):
+        if self.mesh is None:
+            return pos
+        # [T, B]: shard the batch axis; scan steps stay sequential
+        sh = NamedSharding(self.mesh, P(None, self.data_axis))
+        return jax.device_put(pos, sh)

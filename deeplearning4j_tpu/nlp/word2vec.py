@@ -324,6 +324,7 @@ class SequenceVectors(WordVectorsModel):
         # reference uses, saturates instead — `SkipGram.java` per-pair axpy)
         B = max(32, self.batch_size // max(1, self.window_size))
         B = min(B, max(32, self.vocab.num_words()))
+        B = self._sg_round_batch(B)
         # flatten ONCE (token->index lookup is the host-side cost); per-epoch
         # subsampling only re-draws the keep mask over the fixed index array
         base_flat, base_sid = self._flatten_corpus(seqs, subsample=False)
@@ -357,12 +358,20 @@ class SequenceVectors(WordVectorsModel):
                              self.learning_rate * (1.0 - frac))
             lrs[T:] = 0.0
             rng, k = jax.random.split(rng)
+            pos_dev = self._sg_place_positions(jnp.asarray(pos))
             syn0, syn1neg, _loss = runner(
                 syn0, syn1neg, corpus_dev[0], corpus_dev[1],
-                jnp.asarray(pos), jnp.asarray(lrs, jnp.float32), k)
+                pos_dev, jnp.asarray(lrs, jnp.float32), k)
         table.syn0 = syn0
         table.syn1neg = syn1neg
         return self
+
+    # hooks for the distributed subclass (nlp/distributed.py)
+    def _sg_round_batch(self, B: int) -> int:
+        return B
+
+    def _sg_place_positions(self, pos):
+        return pos
 
 
 class Word2Vec(SequenceVectors):

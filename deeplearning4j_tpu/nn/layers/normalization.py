@@ -71,27 +71,38 @@ class BatchNormalization(LayerConf):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but feature/channel axis
-        # Statistics in >= f32: bf16 accumulation over batch*spatial loses
-        # precision and running averages drift (f64 inputs keep f64 so the
-        # gradient-check harness stays exact).
+        # Statistics accumulate in >= f32 (bf16 sums over batch*spatial lose
+        # precision and running averages drift; f64 inputs keep f64 so the
+        # gradient-check harness stays exact) — but the NORMALIZE step is
+        # folded to per-channel scale/shift so the big tensor is touched
+        # once in its own dtype: no materialized f32 copy of x, and XLA can
+        # fuse y = x*scale + shift into the adjacent conv. This is the
+        # fusion the reference buys from cuDNN
+        # (CudnnBatchNormalizationHelper.java).
         cdt = jnp.promote_types(x.dtype, jnp.float32)
-        xf = x.astype(cdt)
         if train:
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # two reduction passes, both with f32 accumulation and the
+            # elementwise (x - mean)^2 fused into the second reduction by
+            # XLA (no materialized f32 copy of x). NOT E[x^2]-E[x]^2: that
+            # one-pass form cancels catastrophically for large-mean
+            # channels (mean ~1e4, std ~1 -> var underflows to 0 in f32)
+            mean = jnp.mean(x, axis=axes, dtype=cdt)
+            var = jnp.mean(lax.square(x.astype(cdt) - mean), axis=axes)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(cdt), state["var"].astype(cdt)
             new_state = state
-        xhat = (xf - mean) * lax.rsqrt(var + self.eps)
+        inv = lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xhat = (xhat * params["gamma"].astype(cdt)
-                    + params["beta"].astype(cdt))
+            scale = params["gamma"].astype(cdt) * inv
+            shift = params["beta"].astype(cdt) - mean * scale
         else:
-            xhat = xhat * self.gamma_init + self.beta_init
-        return self._act(xhat).astype(x.dtype), new_state
+            scale = self.gamma_init * inv
+            shift = self.beta_init - mean * scale
+        y = (x.astype(cdt) * scale + shift).astype(x.dtype)
+        return self._act(y), new_state
 
 
 @register_layer

@@ -534,9 +534,13 @@ class MultiLayerNetwork:
             self.conf.conf.max_num_line_search_iterations)
 
     def _track_signature(self, x, y, fmask, lmask):
-        sig = (tuple(x.shape), tuple(np.shape(y)),
-               None if fmask is None else tuple(fmask.shape),
-               None if lmask is None else tuple(lmask.shape))
+        self._track_signature_shapes(
+            tuple(x.shape), tuple(np.shape(y)),
+            None if fmask is None else tuple(fmask.shape),
+            None if lmask is None else tuple(lmask.shape))
+
+    def _track_signature_shapes(self, xs, ys, fs, ls):
+        sig = (xs, ys, fs, ls)
         if sig not in self._batch_signatures:
             self._batch_signatures.add(sig)
             self.recompile_count += 1
@@ -590,10 +594,13 @@ class MultiLayerNetwork:
         carries = self._zero_carries(int(x.shape[0]), x.dtype)
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
-            self._track_signature(
-                x[:, sl], y[:, sl],
-                None if fmask is None else fmask[:, sl],
-                None if lmask is None else lmask[:, sl])
+            # chunk signature computed arithmetically — no device slicing
+            # just to read shapes
+            n_t = sl.stop - t0
+            chunk = lambda a: (None if a is None else
+                               (a.shape[0], n_t) + tuple(a.shape[2:]))
+            self._track_signature_shapes(
+                chunk(x), chunk(y), chunk(fmask), chunk(lmask))
             self._rng, step_rng = jax.random.split(self._rng)
             step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
             (self.params, self.state, self.updater_state, score,

@@ -1,0 +1,89 @@
+"""Remote stats routing — multi-host observability.
+
+Reference analogs: `RemoteUIStatsStorageRouter`
+(`deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java` — HTTP
+POSTs stats to a remote UI) and the receiving `RemoteReceiverModule`
+(`deeplearning4j-play/.../module/remote/RemoteReceiverModule.java`). In a
+TPU pod each worker host attaches this router to its StatsListener and the
+coordinator (or a laptop) runs `UIServer(...).enable_remote_listener()`;
+training stats flow over plain HTTP, off the ICI fabric.
+
+Includes the reference router's bounded retry queue: transient connection
+failures buffer updates and retry on the next put rather than dropping or
+blocking training.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from collections import deque
+from typing import Deque, Dict, Tuple
+
+from .storage import StatsStorage
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["RemoteUIStatsStorageRouter"]
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """StatsStorage front half only: put_update POSTs to the remote UI.
+    Query methods are unsupported (the storage lives on the receiver)."""
+
+    def __init__(self, url: str, retry_queue_size: int = 512,
+                 timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+        self._retry: Deque[Dict] = deque(maxlen=retry_queue_size)
+        self._lock = threading.Lock()
+
+    def _post(self, payload: Dict) -> bool:
+        req = urllib.request.Request(
+            self.url, json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return 200 <= r.status < 300
+        except Exception as e:
+            log.debug("remote stats post failed: %s", e)
+            return False
+
+    def put_update(self, session_id, type_id, worker_id, timestamp, report):
+        payload = {"session": session_id, "type": type_id,
+                   "worker": worker_id, "ts": timestamp, "report": report}
+        # at most ONE network attempt while the host is unreachable: post
+        # the new payload; only on success drain the backlog. A black-holed
+        # UI host costs the training loop one timeout per iteration, not
+        # (pending+1) timeouts.
+        if not self._post(payload):
+            with self._lock:
+                self._retry.append(payload)
+            return
+        while True:
+            with self._lock:
+                if not self._retry:
+                    return
+                head = self._retry.popleft()
+            if not self._post(head):
+                with self._lock:
+                    self._retry.appendleft(head)
+                return
+
+    @property
+    def pending(self) -> int:
+        return len(self._retry)
+
+    # query half lives on the receiver
+    def list_session_ids(self):
+        raise NotImplementedError("router is write-only; query the UI host")
+
+    def list_type_ids(self, session_id):
+        raise NotImplementedError("router is write-only; query the UI host")
+
+    def list_worker_ids(self, session_id, type_id):
+        raise NotImplementedError("router is write-only; query the UI host")
+
+    def get_all_updates(self, session_id, type_id, worker_id):
+        raise NotImplementedError("router is write-only; query the UI host")

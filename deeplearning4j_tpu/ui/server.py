@@ -116,6 +116,27 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json({"error": "not found"}, 404)
 
+    def do_POST(self):
+        """Remote stats ingestion (`RemoteReceiverModule` analog): workers
+        POST updates from `RemoteUIStatsStorageRouter`."""
+        ui: "UIServer" = self.server.ui  # type: ignore[attr-defined]
+        if urlparse(self.path).path != "/remote":
+            self._json({"error": "not found"}, 404)
+            return
+        if ui.remote_storage is None:
+            self._json({"error": "remote listener not enabled"}, 403)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            ui.remote_storage.put_update(
+                body["session"], body.get("type", "remote"),
+                body.get("worker", "0"), float(body.get("ts", 0.0)),
+                body.get("report", {}))
+            self._json({"status": "ok"})
+        except Exception as e:
+            self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
 
 class UIServer:
     """Singleton dashboard server (`UIServer.getInstance()` in the
@@ -123,11 +144,23 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
         self.port = port
+        self.host = host  # bind 0.0.0.0 to receive remote worker stats
         self._storages: List[StatsStorage] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self.remote_storage: Optional[StatsStorage] = None
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None
+                               ) -> "UIServer":
+        """Accept POSTed stats from remote workers at /remote (reference
+        RemoteReceiverModule) into `storage` (default: a fresh in-memory
+        storage), which is also attached to the dashboard."""
+        from .storage import InMemoryStatsStorage
+
+        self.remote_storage = storage or InMemoryStatsStorage()
+        return self.attach(self.remote_storage)
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -192,7 +225,7 @@ class UIServer:
     def start(self) -> "UIServer":
         if self._httpd is not None:
             return self
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
         self.port = self._httpd.server_address[1]
         self._httpd.ui = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -1,0 +1,53 @@
+"""CLI mains (SURVEY.md §2.10 — ParallelWrapperMain / PlayUIServer.main /
+ClusterSetup parity): argument surfaces + the parallel training main
+end-to-end on the CPU mesh.
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_provision_cli_prints_commands():
+    out = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.provision", "create",
+         "--name", "t1", "--zone", "us-east5-a",
+         "--accelerator", "v5litepod-16"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "gcloud compute tpus tpu-vm create t1" in out.stdout
+    assert "--accelerator-type=v5litepod-16" in out.stdout
+
+
+def test_parallel_cli_trains_and_saves(tmp_path):
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    mpath = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, mpath)
+
+    r = np.random.default_rng(0)
+    feats = r.normal(size=(64, 4)).astype(np.float32)
+    labels = r.integers(0, 3, 64)
+    dpath = str(tmp_path / "d.csv")
+    np.savetxt(dpath, np.column_stack([feats, labels]), delimiter=",",
+               fmt="%.5f")
+
+    from deeplearning4j_tpu.parallel.__main__ import main
+    out_path = str(tmp_path / "out.zip")
+    main(["--model", mpath, "--data", dpath, "--label-index", "-1",
+          "--num-classes", "3", "--batch-size", "32", "--epochs", "2",
+          "--save-to", out_path])
+    trained = ModelSerializer.restore(out_path)
+    assert trained.iteration_count > 0
+    preds = np.asarray(trained.output(feats[:8]))
+    assert preds.shape == (8, 3) and np.isfinite(preds).all()

@@ -197,3 +197,71 @@ def test_vgg16_functional_import(tmp_path):
     expected = m.predict(x, verbose=0)
     got = np.asarray(graph.output(x)[0])
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_assign_keras_weights_in_order(tmp_path):
+    """Ordered kernel/bias mapping from a weights-only HDF5 into our layers
+    (TrainedModelHelper's loading path, tested on a small fabricated file
+    the way the reference uses dl4j-test-resources fixtures)."""
+    import h5py
+
+    from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer, Sgd)
+    from deeplearning4j_tpu.modelimport.trainedmodels import (
+        assign_keras_weights_in_order)
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    stride=(1, 1), activation="relu",
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(DenseLayer(n_out=6, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(5, 5, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(0)
+    k1 = r.normal(size=(3, 3, 3, 4)).astype(np.float32)
+    b1 = r.normal(size=(4,)).astype(np.float32)
+    k2 = r.normal(size=(100, 6)).astype(np.float32)   # 5*5*4 flattened
+    b2 = r.normal(size=(6,)).astype(np.float32)
+    k3 = r.normal(size=(6, 2)).astype(np.float32)
+    b3 = r.normal(size=(2,)).astype(np.float32)
+    p = str(tmp_path / "w.h5")
+    with h5py.File(p, "w") as f:
+        g = f.create_group("block1_conv1")
+        g.create_dataset("block1_conv1_W", data=k1)
+        g.create_dataset("block1_conv1_b", data=b1)
+        g = f.create_group("fc1")
+        g.create_dataset("fc1_W", data=k2)
+        g.create_dataset("fc1_b", data=b2)
+        g = f.create_group("predictions")
+        g.create_dataset("predictions_W", data=k3)
+        g.create_dataset("predictions_b", data=b3)
+    assign_keras_weights_in_order(net, p)
+    np.testing.assert_allclose(np.asarray(net.params[0]["W"]), k1)
+    np.testing.assert_allclose(np.asarray(net.params[1]["W"]), k2)
+    np.testing.assert_allclose(np.asarray(net.params[2]["b"]), b3)
+
+
+def test_assign_keras_weights_shape_mismatch_raises(tmp_path):
+    import h5py
+
+    from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer, Sgd)
+    from deeplearning4j_tpu.modelimport.trainedmodels import (
+        assign_keras_weights_in_order)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list()
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    p = str(tmp_path / "bad.h5")
+    with h5py.File(p, "w") as f:
+        g = f.create_group("dense")
+        g.create_dataset("W", data=np.zeros((7, 2), np.float32))
+        g.create_dataset("b", data=np.zeros((2,), np.float32))
+    with pytest.raises(ValueError, match="kernel shape"):
+        assign_keras_weights_in_order(net, p)

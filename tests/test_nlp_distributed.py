@@ -1,0 +1,52 @@
+"""Distributed Word2Vec == single-device Word2Vec on the 8-device mesh
+(the reference's Spark-vs-single-machine equivalence pattern,
+TestCompareParameterAveragingSparkVsSingleMachine.java:44).
+"""
+import numpy as np
+
+from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.parallel import make_mesh
+
+
+def _corpus(n=2000, seed=0):
+    r = np.random.default_rng(seed)
+    words = r.zipf(1.3, size=(n, 12)) % 300
+    return [" ".join(f"w{w}" for w in row) for row in words]
+
+
+def _kw():
+    return dict(layer_size=32, window_size=4, negative=5, epochs=2,
+                min_word_frequency=1, seed=9, batch_size=2048)
+
+
+def test_distributed_w2v_matches_single_device():
+    sents = _corpus()
+    single = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                      **_kw())
+    single.fit()
+    dist = DistributedWord2Vec(
+        mesh=make_mesh({"data": 8}),
+        sentence_iterator=CollectionSentenceIterator(sents), **_kw())
+    dist.fit()
+    a = single.lookup_table.vectors_matrix()
+    b = dist.lookup_table.vectors_matrix()
+    np.testing.assert_allclose(b, a, rtol=5e-4, atol=1e-5)
+
+
+def test_distributed_w2v_learns():
+    sents = []
+    for i in range(800):
+        a = ["cat", "dog", "pet", "fur"][i % 4]
+        b = ["car", "road", "wheel", "drive"][i % 4]
+        sents.append(f"{a} {a} pet animal fur tail")
+        sents.append(f"{b} {b} vehicle road wheel engine")
+    w2v = DistributedWord2Vec(
+        mesh=make_mesh({"data": 8}),
+        sentence_iterator=CollectionSentenceIterator(sents),
+        layer_size=32, window_size=3, negative=5, epochs=2,
+        min_word_frequency=1, seed=4)
+    w2v.fit()
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "wheel") + 0.1

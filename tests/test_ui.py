@@ -133,3 +133,51 @@ def test_stats_listener_works_with_computation_graph():
     updates = storage.get_all_updates("graph", StatsListener.TYPE_ID, "local")
     assert len(updates) == 3
     assert "d/W" in updates[-1][1]["params"]
+
+
+def test_remote_stats_router_roundtrip():
+    """RemoteUIStatsStorageRouter -> /remote -> dashboard data (the
+    reference's RemoteUIStatsStorageRouter + RemoteReceiverModule pair)."""
+    from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    srv = UIServer(port=0).enable_remote_listener().start()
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{srv.port}")
+        for i in range(3):
+            router.put_update("sess-r", "stats", "worker-1", float(i),
+                              {"iteration": i, "score": 1.0 / (i + 1)})
+        assert router.pending == 0
+        data = srv.train_data("sess-r")
+        assert data["session"] == "sess-r"
+        assert data["scores"] == [1.0, 0.5, 1.0 / 3.0]
+    finally:
+        srv.stop()
+
+
+def test_remote_router_buffers_when_server_down():
+    from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9",  # closed port
+                                        timeout=0.3)
+    router.put_update("s", "t", "w", 0.0, {"score": 1.0})
+    assert router.pending == 1
+
+
+def test_remote_endpoint_requires_enable():
+    from deeplearning4j_tpu.ui.server import UIServer
+    import json as _json
+    import urllib.request
+
+    srv = UIServer(port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/remote",
+            _json.dumps({"session": "s"}).encode(),
+            {"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            assert False, "expected 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+    finally:
+        srv.stop()
