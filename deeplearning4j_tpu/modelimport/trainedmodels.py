@@ -80,6 +80,20 @@ def assign_keras_weights_in_order(net, h5_path: str):
                 "Keras 3 .weights.h5 layout detected; save the FULL model "
                 "(.h5/.keras) and use modelimport.keras import functions, "
                 "or use a legacy keras-applications weight file here")
+        # alphabetical h5 iteration must equal natural order, else
+        # default-named files (conv2d_2 ... conv2d_10) silently misassign
+        import re as _re
+
+        def natural(s):
+            return [int(t) if t.isdigit() else t
+                    for t in _re.split(r"(\d+)", s)]
+
+        names = list(f.keys())
+        if sorted(names) != sorted(names, key=natural):
+            raise ValueError(
+                "HDF5 group names are not ordering-safe (numeric suffixes "
+                "sort differently alphabetically vs naturally); use the "
+                "full-model modelimport.keras path instead")
         pairs = _collect_weight_pairs(f)
     new_params = list(net.params)
     idx = 0

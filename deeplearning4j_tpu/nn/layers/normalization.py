@@ -96,12 +96,25 @@ class BatchNormalization(LayerConf):
             new_state = state
         inv = lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            scale = params["gamma"].astype(cdt) * inv
-            shift = params["beta"].astype(cdt) - mean * scale
+            gamma = params["gamma"].astype(cdt)
+            beta = params["beta"].astype(cdt)
         else:
-            scale = self.gamma_init * inv
-            shift = self.beta_init - mean * scale
-        y = (x.astype(cdt) * scale + shift).astype(x.dtype)
+            gamma = jnp.asarray(self.gamma_init, cdt)
+            beta = jnp.asarray(self.beta_init, cdt)
+        if jnp.dtype(x.dtype).itemsize < 4:
+            # bf16/f16 activations: fold to y = x*scale + shift (one fused
+            # elementwise pass; x's own 8-bit mantissa already bounds the
+            # precision, folding loses nothing)
+            scale = gamma * inv
+            y = (x.astype(cdt) * scale + (beta - mean * scale)) \
+                .astype(x.dtype)
+        else:
+            # f32/f64 activations: keep (x - mean) explicit — for
+            # large-mean channels the nearby-value subtraction is exact
+            # (Sterbenz) where the folded form loses ~4 decades; XLA fuses
+            # this chain just as well in full precision
+            y = ((x.astype(cdt) - mean) * (inv * gamma) + beta) \
+                .astype(x.dtype)
         return self._act(y), new_state
 
 

@@ -56,7 +56,8 @@ def _fold_block(q, k_blk, v_blk, m, l, o, scale, blk_mask=None):
     return m_new, l_new, o_new
 
 
-def blockwise_attention(q, k, v, block_size: int = 128):
+def blockwise_attention(q, k, v, block_size: int = 128,
+                        causal: bool = False):
     """Single-device blockwise (memory-efficient) attention over K/V blocks —
     identical math to the ring, with the ring permute replaced by a scan over
     local blocks. On TPU this dispatches to the Pallas flash kernel
@@ -65,7 +66,7 @@ def blockwise_attention(q, k, v, block_size: int = 128):
     from ..kernels import flash_attention, pallas_supported
 
     if pallas_supported():
-        return flash_attention(q, k, v, block_q=block_size,
+        return flash_attention(q, k, v, causal=causal, block_q=block_size,
                                block_k=block_size)
     B, T, H = q.shape
     S = k.shape[1]
@@ -74,10 +75,13 @@ def blockwise_attention(q, k, v, block_size: int = 128):
     pad = nb * block_size - S
     k_p = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
     v_p = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-    valid = jnp.arange(nb * block_size) < S
+    kv_idx = jnp.arange(nb * block_size)
+    valid = kv_idx < S
     k_blocks = k_p.reshape(B, nb, -1, H).swapaxes(0, 1)   # [nb, B, bs, H]
     v_blocks = v_p.reshape(B, nb, -1, H).swapaxes(0, 1)
     valid_blocks = valid.reshape(nb, -1)
+    kv_idx_blocks = kv_idx.reshape(nb, -1)
+    q_idx = jnp.arange(T)
 
     m = jnp.full((B, T, 1), -jnp.inf, q.dtype)
     l = jnp.zeros((B, T, 1), q.dtype)
@@ -85,22 +89,32 @@ def blockwise_attention(q, k, v, block_size: int = 128):
 
     def body(carry, blk):
         m, l, o = carry
-        k_b, v_b, val = blk
+        k_b, v_b, val, ki = blk
         mask = val[None, None, :]
+        if causal:
+            mask = mask & (ki[None, None, :] <= q_idx[None, :, None])
         m, l, o = _fold_block(q, k_b, v_b, m, l, o, scale, blk_mask=mask)
         return (m, l, o), None
 
-    (m, l, o), _ = jax.lax.scan(body, (m, l, o),
-                                (k_blocks, v_blocks, valid_blocks))
+    (m, l, o), _ = jax.lax.scan(
+        body, (m, l, o),
+        (k_blocks, v_blocks, valid_blocks, kv_idx_blocks))
     return o / jnp.maximum(l, 1e-30)
 
 
-def ring_self_attention(q, k, v, axis_name: str):
+def ring_self_attention(q, k, v, axis_name: str, causal: bool = False):
     """Ring attention body — call inside shard_map with q/k/v sharded on the
     sequence axis. Each step folds the resident K/V block and permutes K/V to
     the next device; after `n` steps every query block has seen every K/V
-    block. One ICI hop per step, compute/communication overlapped by XLA."""
+    block. One ICI hop per step, compute/communication overlapped by XLA.
+
+    causal=True masks by GLOBAL sequence position: the K/V block resident
+    at step i originated on device (me - i) mod n, so its rows sit at
+    global offset src*T; a block strictly right of this device's query
+    range folds in fully masked (contributing nothing), the diagonal block
+    gets the triangular mask, and blocks to the left fold in whole."""
     n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
     B, T, H = q.shape
 
@@ -111,7 +125,15 @@ def ring_self_attention(q, k, v, axis_name: str):
 
     def body(i, carry):
         m, l, o, k_blk, v_blk = carry
-        m, l, o = _fold_block(q, k_blk, v_blk, m, l, o, scale)
+        if causal:
+            src = (me - i) % n
+            q_pos = me * T + jnp.arange(T)[:, None]       # [T, 1]
+            kv_pos = src * T + jnp.arange(T)[None, :]     # [1, S]
+            blk_mask = (kv_pos <= q_pos)[None]            # [1, T, S]
+            m, l, o = _fold_block(q, k_blk, v_blk, m, l, o, scale,
+                                  blk_mask=blk_mask)
+        else:
+            m, l, o = _fold_block(q, k_blk, v_blk, m, l, o, scale)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return m, l, o, k_blk, v_blk
@@ -120,12 +142,14 @@ def ring_self_attention(q, k, v, axis_name: str):
     return o / jnp.maximum(l, 1e-30)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "seq"):
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "seq",
+                           causal: bool = False):
     """Host-level entry: shard [B, T, H] on T over `axis` and run the ring."""
     from jax import shard_map
 
     spec = P(None, axis, None)
-    fn = shard_map(functools.partial(ring_self_attention, axis_name=axis),
+    fn = shard_map(functools.partial(ring_self_attention, axis_name=axis,
+                                     causal=causal),
                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                    check_vma=False)
     sh = NamedSharding(mesh, spec)

@@ -29,13 +29,16 @@ def main(argv=None):
         name=args.name, zone=args.zone, accelerator_type=args.accelerator,
         runtime_version=args.version, project=args.project,
         preemptible=args.preemptible))
-    cmd = {"create": setup.create_command,
-           "delete": setup.delete_command,
-           "ssh": lambda: setup.ssh_command(args.command)}[args.action]()
     if args.apply:
-        out = setup._run(cmd, dry_run=False)
-        print(out or "")
+        run = {"create": setup.create,
+               "delete": setup.delete,
+               "ssh": lambda **kw: setup.run_on_workers(args.command,
+                                                        **kw)}[args.action]
+        print(run(dry_run=False) or "")
     else:
+        cmd = {"create": setup.create_command,
+               "delete": setup.delete_command,
+               "ssh": lambda: setup.ssh_command(args.command)}[args.action]()
         print(" ".join(shlex.quote(c) for c in cmd))
 
 

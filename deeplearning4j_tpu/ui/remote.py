@@ -53,23 +53,22 @@ class RemoteUIStatsStorageRouter(StatsStorage):
     def put_update(self, session_id, type_id, worker_id, timestamp, report):
         payload = {"session": session_id, "type": type_id,
                    "worker": worker_id, "ts": timestamp, "report": report}
-        # at most ONE network attempt while the host is unreachable: post
-        # the new payload; only on success drain the backlog. A black-holed
-        # UI host costs the training loop one timeout per iteration, not
-        # (pending+1) timeouts.
-        if not self._post(payload):
-            with self._lock:
-                self._retry.append(payload)
-            return
+        # enqueue-then-drain-from-head: updates always deliver in order
+        # (the dashboard's 'latest' stays monotonic), and a black-holed UI
+        # host costs the training loop ONE timeout per iteration, not
+        # (pending+1) timeouts — the drain stops at the first failure.
+        with self._lock:
+            self._retry.append(payload)
         while True:
             with self._lock:
                 if not self._retry:
                     return
-                head = self._retry.popleft()
+                head = self._retry[0]
             if not self._post(head):
-                with self._lock:
-                    self._retry.appendleft(head)
                 return
+            with self._lock:
+                if self._retry and self._retry[0] is head:
+                    self._retry.popleft()
 
     @property
     def pending(self) -> int:

@@ -163,9 +163,10 @@ class UIServer:
         return self.attach(self.remote_storage)
 
     @classmethod
-    def get_instance(cls, port: int = 9000) -> "UIServer":
+    def get_instance(cls, port: int = 9000,
+                     host: str = "127.0.0.1") -> "UIServer":
         if cls._instance is None:
-            cls._instance = UIServer(port)
+            cls._instance = UIServer(port, host=host)
         return cls._instance
 
     def attach(self, storage: StatsStorage) -> "UIServer":
