@@ -181,7 +181,14 @@ class ComputationGraph:
         for name, p in params.items():
             if p:
                 reg = reg + self.conf.vertices[name].reg_score(p)
-        return total + reg / batch, new_state
+        score = total + reg / batch
+        # layer auxiliary losses (MoE router load balancing) — train only
+        if train:
+            for name, s in new_state.items():
+                v = self.conf.vertices.get(name)
+                if v is not None and hasattr(v, "aux_score"):
+                    score = score + v.aux_score(s)
+        return score, new_state
 
     def _make_train_step(self):
         def train_step(params, state, opt_state, step, inputs, labels, rng,

@@ -17,6 +17,7 @@ from .generative import (AutoEncoder, RBM, VariationalAutoencoder,
                          BernoulliReconstructionDistribution,
                          CompositeReconstructionDistribution,
                          LossFunctionWrapper)
+from .moe import MixtureOfExpertsLayer
 
 __all__ = [
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
@@ -30,4 +31,5 @@ __all__ = [
     "AutoEncoder", "RBM", "VariationalAutoencoder", "CenterLossOutputLayer",
     "GaussianReconstructionDistribution", "BernoulliReconstructionDistribution",
     "CompositeReconstructionDistribution", "LossFunctionWrapper",
+    "MixtureOfExpertsLayer",
 ]

@@ -1,0 +1,118 @@
+"""Mixture-of-Experts layer + expert parallelism.
+
+NEW capability relative to the reference (SURVEY.md §2.4 parallelism table:
+"Expert parallelism / MoE — NO"), completing the parallelism alphabet
+(dp/tp/pp/sp/ep). TPU-first design choices:
+
+  * DENSE expert compute: every expert processes every token and the
+    router's top-k gates (zeros elsewhere) combine them. For the moderate
+    expert counts this layer targets, batched [E, ...] einsums keep the
+    MXU busy with static shapes — no gather/scatter token dispatch, no
+    capacity-overflow dropping, and `jax.grad` differentiates the gates
+    exactly.
+  * Expert parallelism is a SHARDING RULE, not a runtime: expert-indexed
+    params ([E, ...], keys prefixed `expert_`) shard on their leading axis
+    (`parallel/sharding.py`); XLA partitions the expert einsums and
+    inserts the psum that combines expert contributions over ICI.
+  * Router load-balance auxiliary loss (Shazeer/Switch style
+    E * sum_e f_e * p_e) is returned via the state side-channel and added
+    to the training score by `aux_score` — set `load_balance_coef` > 0.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.base import LayerConf, register_layer
+from ..conf.input_type import InputType
+
+__all__ = ["MixtureOfExpertsLayer"]
+
+
+@register_layer
+@dataclass
+class MixtureOfExpertsLayer(LayerConf):
+    """Top-k routed mixture of two-layer FFN experts.
+
+    x [B, n_in] -> router logits [B, E] -> top-k softmax gates ->
+    y = sum_k gate_k * FFN_k(x), FFN_e = W2_e @ act(W1_e @ x + b1_e) + b2_e.
+    """
+
+    input_kind = "ff"
+
+    n_out: int = 0
+    n_experts: int = 4
+    top_k: int = 2
+    expert_hidden: int = 0          # default: 4 * n_out
+    load_balance_coef: float = 0.0  # aux loss weight (0 = off)
+    router_noise: float = 0.0       # train-time routing noise stddev
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def _hidden(self) -> int:
+        return self.expert_hidden or 4 * self.n_out
+
+    def init_params(self, rng, it: InputType) -> Dict[str, jax.Array]:
+        n_in = it.flat_size()
+        h = self._hidden()
+        e = self.n_experts
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "router_W": self._winit(k1, (n_in, e), n_in, e),
+            # expert_-prefixed tensors shard on axis 0 (expert parallelism)
+            "expert_W1": self._winit(k2, (e, n_in, h), n_in, h),
+            "expert_b1": self._binit((e, h)),
+            "expert_W2": self._winit(k3, (e, h, self.n_out), h, self.n_out),
+            "expert_b2": self._binit((e, self.n_out)),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if rng is not None:
+            rng, noise_rng = jax.random.split(rng)
+        else:
+            noise_rng = None
+        x = self.maybe_dropout_input(x, train, rng)
+        logits = x @ params["router_W"]                      # [B, E]
+        if train and self.router_noise > 0 and noise_rng is not None:
+            logits = logits + self.router_noise * jax.random.normal(
+                noise_rng, logits.shape, logits.dtype)
+        k = min(self.top_k, self.n_experts)
+        # exact top-k via index scatter (a value threshold would admit ALL
+        # tied experts, degrading to dense routing on e.g. zero inputs)
+        top_vals, top_idx = jax.lax.top_k(logits, k)         # [B, k]
+        top_gates = jax.nn.softmax(top_vals, axis=-1)
+        gates = jnp.zeros_like(logits).at[
+            jnp.arange(logits.shape[0])[:, None], top_idx].set(top_gates)
+        # dense expert compute: [B, E, h] -> [B, E, out]
+        hid = self._act(jnp.einsum("bi,eih->beh", x, params["expert_W1"])
+                        + params["expert_b1"])
+        outs = (jnp.einsum("beh,eho->beo", hid, params["expert_W2"])
+                + params["expert_b2"])
+        y = jnp.einsum("beo,be->bo", outs, gates.astype(outs.dtype))
+        if train and self.load_balance_coef > 0:
+            # Switch-style aux: E * sum_e (fraction routed to e) * (mean
+            # router prob of e); stored in state for aux_score
+            probs = jax.nn.softmax(logits, axis=-1)
+            frac = jnp.mean((gates > 0).astype(jnp.float32), axis=0)
+            aux = self.n_experts * jnp.sum(
+                frac * jnp.mean(probs, axis=0).astype(jnp.float32))
+            state = dict(state)
+            state["aux_loss"] = aux
+        return y, state
+
+    def init_state(self, it: InputType) -> Dict[str, jax.Array]:
+        return ({"aux_loss": jnp.float32(0.0)}
+                if self.load_balance_coef > 0 else {})
+
+    def aux_score(self, state) -> jax.Array:
+        if self.load_balance_coef > 0 and "aux_loss" in state:
+            return self.load_balance_coef * state["aux_loss"]
+        return jnp.float32(0.0)

@@ -188,6 +188,13 @@ class MultiLayerNetwork:
                                     train=train, rng=out_rng, mask=eff_lmask)
         batch = x.shape[0]
         score = loss + self._reg_score(params) / batch
+        # layer auxiliary losses from the state side-channel (MoE router
+        # load balancing, nn/layers/moe.py) — train only: eval state holds
+        # a stale aux from the last training batch
+        if train:
+            for layer, s in zip(self.layers, new_state):
+                if hasattr(layer, "aux_score"):
+                    score = score + layer.aux_score(s)
         return score, (new_state, new_carries)
 
     def _layer_lr(self, layer: LayerConf, step):

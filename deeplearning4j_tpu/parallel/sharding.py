@@ -36,11 +36,16 @@ class ShardingStrategy:
 
 
 def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh):
-    """Output-feature-axis sharding for a single param tensor."""
+    """Output-feature-axis sharding for a single param tensor. Expert-
+    indexed tensors (`expert_*`, leading axis = n_experts — see
+    nn/layers/moe.py) shard on axis 0 instead: expert parallelism."""
     size = mesh.shape[axis]
     nd = len(shape)
     if nd == 0:
         return P()
+    if key.startswith("expert_") and shape[0] % size == 0 \
+            and shape[0] >= size:
+        return P(*([axis] + [None] * (nd - 1)))
     # shard last axis (output features / channels / gate blocks) if divisible
     if shape[-1] % size == 0 and shape[-1] >= size:
         return P(*([None] * (nd - 1) + [axis]))

@@ -1,0 +1,199 @@
+"""Mixture-of-Experts layer + expert parallelism.
+
+New capability vs the reference (SURVEY §2.4: MoE/expert parallelism
+absent). Correctness follows the repo's standard patterns: gradient check
+vs numeric differences, expert-parallel == single-device parameter
+equivalence, and a learning test where disjoint input clusters demand
+different experts.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd)
+from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+from deeplearning4j_tpu.parallel import (ParallelTrainer, ShardingStrategy,
+                                         TrainingMode, make_mesh,
+                                         param_specs)
+
+
+def _moe_net(seed=3, n_experts=4, top_k=2, lb=0.0, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Sgd(0.05))
+            .list()
+            .layer(MixtureOfExpertsLayer(n_out=16, n_experts=n_experts,
+                                         top_k=top_k, expert_hidden=32,
+                                         activation="relu",
+                                         load_balance_coef=lb))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, n)]
+    return x, y
+
+
+def test_moe_forward_shapes_and_gates():
+    net = _moe_net()
+    x, _ = _data(32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (32, 4)
+    assert np.isfinite(out).all()
+    # top-1 routing: output must equal the argmax expert's FFN exactly
+    net1 = _moe_net(top_k=1)
+    layer = net1.layers[0]
+    p = net1.params[0]
+    xj = jnp.asarray(x)
+    y1, _ = layer.apply(p, net1.state[0], xj)
+    logits = np.asarray(xj @ p["router_W"])
+    pick = logits.argmax(1)
+    hid = np.maximum(
+        np.einsum("bi,eih->beh", x, np.asarray(p["expert_W1"]))
+        + np.asarray(p["expert_b1"]), 0.0)
+    outs = (np.einsum("beh,eho->beo", hid, np.asarray(p["expert_W2"]))
+            + np.asarray(p["expert_b2"]))
+    expect = outs[np.arange(len(x)), pick]
+    np.testing.assert_allclose(np.asarray(y1), expect, rtol=2e-5, atol=1e-5)
+
+
+def test_moe_gradient_check():
+    """Numeric-vs-analytic gradients (x64) away from routing boundaries."""
+    net = _moe_net(seed=11)
+    r = np.random.default_rng(1)
+    x = jnp.asarray(r.normal(size=(8, 8)))
+    y = jnp.asarray(np.eye(4)[r.integers(0, 4, 8)].astype(np.float64))
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64),
+                                    net.params)
+
+    def loss(p):
+        s, _ = net._loss_fn(p, net.state, x, y, None, train=False)
+        return s
+
+    g = jax.grad(loss)(params)
+    flat_g, treedef = jax.tree_util.tree_flatten(g)
+    flat_p, _ = jax.tree_util.tree_flatten(params)
+    eps = 1e-6
+    checked = 0
+    for ti, (pv, gv) in enumerate(zip(flat_p, flat_g)):
+        pn = np.asarray(pv, np.float64)
+        gn = np.asarray(gv, np.float64)
+        for _ in range(3):
+            idx = tuple(r.integers(0, s) for s in pn.shape)
+            pp, pm = pn.copy(), pn.copy()
+            pp[idx] += eps
+            pm[idx] -= eps
+            fp = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(pp) if i == ti else flat_p[i]
+                          for i in range(len(flat_p))])
+            fm = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(pm) if i == ti else flat_p[i]
+                          for i in range(len(flat_p))])
+            num = (float(loss(fp)) - float(loss(fm))) / (2 * eps)
+            rel = abs(num - gn[idx]) / max(abs(num) + abs(gn[idx]), 1e-9)
+            assert rel < 1e-5, (ti, idx, num, gn[idx])
+            checked += 1
+    assert checked >= 15
+
+
+def test_expert_parallel_matches_single_device():
+    """Expert-parallel training (expert_* params sharded on their leading
+    axis) == single-device — the repo's distributed-equivalence pattern."""
+    x, y = _data(64, seed=5)
+    ds = DataSet(x, y)
+    single = _moe_net(seed=21, updater=Adam(1e-2))
+    multi = _moe_net(seed=21, updater=Adam(1e-2))
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 2, "model": 4}),
+                              mode=TrainingMode.SYNC,
+                              strategy=ShardingStrategy.TENSOR_PARALLEL)
+    for _ in range(4):
+        single.fit(ds)
+        trainer.fit(ds)
+    np.testing.assert_allclose(multi.params_flat(), single.params_flat(),
+                               rtol=5e-4, atol=1e-5)
+
+
+def test_expert_params_are_sharded_on_expert_axis():
+    mesh = make_mesh({"data": 2, "model": 4})
+    net = _moe_net()
+    specs = param_specs(net.params, ShardingStrategy.TENSOR_PARALLEL, mesh)
+    moe_specs = specs[0]
+    for key in ("expert_W1", "expert_b1", "expert_W2", "expert_b2"):
+        assert moe_specs[key][0] == "model", (key, moe_specs[key])
+
+
+def test_moe_learns_cluster_specialization():
+    """Disjoint input clusters with different input->label maps: a routed
+    MoE should fit this comfortably."""
+    r = np.random.default_rng(3)
+    n = 256
+    cluster = r.integers(0, 2, n)
+    x = r.normal(size=(n, 8)).astype(np.float32) + cluster[:, None] * 8.0
+    w0 = r.normal(size=(8, 4)).astype(np.float32)
+    w1 = r.normal(size=(8, 4)).astype(np.float32)
+    logits = np.where(cluster[:, None] == 0, x @ w0, x @ w1)
+    y = np.eye(4, dtype=np.float32)[logits.argmax(1)]
+    net = _moe_net(seed=7, n_experts=4, top_k=1, lb=0.01,
+                   updater=Adam(5e-3))
+    ds = DataSet(x, y)
+    for _ in range(150):
+        net.fit(ds)
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    ev = net.evaluate(ArrayDataSetIterator(x, y, batch_size=64))
+    assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+def test_moe_aux_loss_present_and_finite():
+    net = _moe_net(lb=0.05)
+    x, y = _data(32)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(float(net.score()))
+
+
+def test_moe_json_roundtrip():
+    net = _moe_net()
+    js = net.conf.to_json()
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    l0 = conf2.layers[0]
+    assert isinstance(l0, MixtureOfExpertsLayer)
+    assert l0.n_experts == 4 and l0.top_k == 2
+
+
+def test_moe_ties_still_route_exactly_k():
+    """Tied logits (zero inputs) must not degrade to dense routing."""
+    net = _moe_net(top_k=2, n_experts=4)
+    layer, p = net.layers[0], net.params[0]
+    x = jnp.zeros((5, 8), jnp.float32)   # router logits all equal
+    y, _ = layer.apply(p, net.state[0], x)
+    logits = x @ p["router_W"]
+    top_vals, top_idx = jax.lax.top_k(logits, 2)
+    # recompute gates the layer's way and count nonzeros per row
+    gates = jnp.zeros_like(logits).at[
+        jnp.arange(5)[:, None], top_idx].set(jax.nn.softmax(top_vals, -1))
+    assert int((np.asarray(gates) > 0).sum(1).max()) <= 2
+
+
+def test_moe_eval_score_excludes_aux():
+    """score(train=False) must not include the stale train-batch aux."""
+    net = _moe_net(lb=0.5)
+    x, y = _data(32)
+    net.fit(DataSet(x, y))
+    s_eval = float(net._score_fn(net.params, net.state,
+                                 jnp.asarray(x), jnp.asarray(y), None, None))
+    # recompute pure loss with aux coefficient zeroed via a twin layer
+    net2 = _moe_net(lb=0.0)
+    net2.params, net2.state = net.params, tuple(
+        {k: v for k, v in s.items() if k != "aux_loss"} for s in net.state)
+    s_pure = float(net2._score_fn(net2.params, net2.state,
+                                  jnp.asarray(x), jnp.asarray(y), None,
+                                  None))
+    np.testing.assert_allclose(s_eval, s_pure, rtol=1e-6)
