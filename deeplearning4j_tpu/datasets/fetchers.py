@@ -1,5 +1,6 @@
 """Benchmark dataset fetchers: MNIST (IDX binary), Iris (embedded), CIFAR-10
-(binary batches).
+(binary batches), LFW (person-labeled face JPEGs), Curves (synthetic
+autoencoder benchmark).
 
 Reference parity:
   * MNIST — `deeplearning4j-core/.../datasets/fetchers/MnistDataFetcher.java:40`
@@ -9,6 +10,10 @@ Reference parity:
     150 rows as a resource; here they're embedded).
   * CIFAR-10 — `datasets/iterator/impl/CifarDataSetIterator.java:17` (binary
     "data_batch_N.bin" records: 1 label byte + 3072 channel-major bytes).
+  * LFW — `datasets/fetchers/LFWDataFetcher.java` / `LFWDataSetIterator.java`
+    (download + person-directory traversal + resize).
+  * Curves — `datasets/fetchers/CurvesDataFetcher.java` (the Hinton
+    deep-autoencoder curves set; generated deterministically here).
 
 Cache layout: $DL4J_TPU_DATA_DIR (default ~/.deeplearning4j_tpu) /<dataset>/.
 Downloads only happen when the cache misses; offline environments can drop
@@ -27,7 +32,8 @@ import numpy as np
 
 __all__ = [
     "data_dir", "read_idx", "MnistDataFetcher", "IrisDataFetcher",
-    "CifarDataFetcher", "IRIS_FEATURES", "IRIS_LABELS",
+    "CifarDataFetcher", "LFWDataFetcher", "CurvesDataFetcher",
+    "IRIS_FEATURES", "IRIS_LABELS",
 ]
 
 _MNIST_URLS = [
@@ -266,3 +272,123 @@ class CifarDataFetcher:
         x = np.concatenate(xs).astype(np.float32) / 255.0
         y = np.eye(10, dtype=np.float32)[np.concatenate(ys).astype(np.int64)]
         return x, y
+
+
+_LFW_URL = "https://vis-www.cs.umass.edu/lfw/lfw.tgz"
+
+
+class LFWDataFetcher:
+    """Labeled Faces in the Wild (reference `LFWDataSetIterator.java` /
+    `datasets/fetchers/LFWDataFetcher.java`): person-labeled face JPEGs.
+    `fetch()` -> (images [N, H, W, 3] float32 in [0,1], labels one-hot over
+    the `num_labels` most frequent people). Downloads + caches the official
+    tarball; offline hosts must place `lfw.tgz` (or the extracted `lfw/`
+    tree) in the cache dir."""
+
+    def __init__(self, image_size: int = 64, num_labels: int = 0,
+                 min_images_per_person: int = 1,
+                 cache: Optional[str] = None):
+        self.image_size = int(image_size)
+        self.num_labels = int(num_labels)
+        self.min_images = int(min_images_per_person)
+        self.cache = cache or data_dir("lfw")
+
+    def _root(self) -> str:
+        root = os.path.join(self.cache, "lfw")
+        if os.path.isdir(root):
+            return root
+        tarball = os.path.join(self.cache, "lfw.tgz")
+        if not os.path.exists(tarball):
+            if not _download(_LFW_URL, tarball, timeout=600):
+                raise FileNotFoundError(
+                    f"LFW not in cache {self.cache} and download failed "
+                    "(offline?). Place lfw.tgz or the extracted lfw/ "
+                    "directory there manually.")
+        with tarfile.open(tarball, "r:gz") as tf:
+            tf.extractall(self.cache, filter="data")
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{tarball} did not extract an 'lfw/' directory; expected "
+                "the official LFW tarball layout (person-named "
+                "subdirectories under lfw/)")
+        return root
+
+    def _counted(self, root: str):
+        """[(person, image files)] after min-images filtering and
+        num_labels selection — the single definition of class ordering."""
+        counted = []
+        for person in sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))):
+            files = sorted(
+                f for f in os.listdir(os.path.join(root, person))
+                if f.lower().endswith((".jpg", ".jpeg", ".png")))
+            if len(files) >= self.min_images:
+                counted.append((person, files))
+        if self.num_labels > 0:
+            counted.sort(key=lambda pf: (-len(pf[1]), pf[0]))
+            counted = counted[: self.num_labels]
+            counted.sort(key=lambda pf: pf[0])
+        return counted
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        from PIL import Image
+
+        root = self._root()
+        counted = self._counted(root)
+        xs, ys = [], []
+        s = self.image_size
+        for label, (person, files) in enumerate(counted):
+            for f in files:
+                img = Image.open(os.path.join(root, person, f))
+                img = img.convert("RGB").resize((s, s))
+                xs.append(np.asarray(img, np.float32) / 255.0)
+                ys.append(label)
+        n_cls = len(counted)
+        x = np.stack(xs) if xs else np.zeros((0, s, s, 3), np.float32)
+        y = (np.eye(n_cls, dtype=np.float32)[np.asarray(ys, np.int64)]
+             if xs else np.zeros((0, n_cls), np.float32))
+        return x, y
+
+    def labels(self) -> List[str]:
+        """Person names in class-index order — labels()[k] names one-hot
+        column k of fetch()'s labels."""
+        return [p for p, _ in self._counted(self._root())]
+
+
+class CurvesDataFetcher:
+    """Synthetic "curves" dataset (reference `CurvesDataFetcher.java` — the
+    Hinton deep-autoencoder benchmark: 28x28 images of smooth random
+    curves). The reference downloads a serialized copy; here the dataset is
+    generated deterministically from a seed (quadratic Bezier strokes
+    rasterized with anti-aliasing), which keeps it available offline and
+    infinitely extensible."""
+
+    def __init__(self, n_examples: int = 10000, image_size: int = 28,
+                 seed: int = 123):
+        self.n = int(n_examples)
+        self.size = int(image_size)
+        self.seed = int(seed)
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        r = np.random.default_rng(self.seed)
+        s = self.size
+        t = np.linspace(0.0, 1.0, 64)[:, None]          # curve parameter
+        # control points for quadratic Bezier curves, [N, 3, 2] in [0, s)
+        ctrl = r.uniform(2, s - 2, size=(self.n, 3, 2))
+        pts = ((1 - t) ** 2 * ctrl[:, None, 0]
+               + 2 * (1 - t) * t * ctrl[:, None, 1]
+               + t ** 2 * ctrl[:, None, 2])             # [N, T, 2]
+        imgs = np.zeros((self.n, s, s), np.float32)
+        ij = np.floor(pts).astype(np.int64)
+        frac = pts - ij
+        n_idx = np.repeat(np.arange(self.n), t.shape[0])
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy = np.clip(ij[..., 1] + dy, 0, s - 1).ravel()
+                xx = np.clip(ij[..., 0] + dx, 0, s - 1).ravel()
+                w = (np.abs(1 - dy - frac[..., 1])
+                     * np.abs(1 - dx - frac[..., 0])).ravel()
+                np.add.at(imgs, (n_idx, yy, xx), w)
+        x = np.clip(imgs, 0.0, 1.0).reshape(self.n, -1)
+        return x, x.copy()   # autoencoder dataset: target == input
