@@ -64,15 +64,22 @@ class ROC:
         y = np.concatenate([[0.0], tprs[order], [1.0]])
         return float(np.trapezoid(y, x))
 
-    def calculate_auprc(self) -> float:
-        """Area under precision-recall curve (trapezoid over the grid)."""
-        recs, precs = [], []
-        for i in range(len(self.thresholds)):
+    def get_precision_recall_curve(self):
+        """[(threshold, recall, precision)] per threshold (reference
+        `getPrecisionRecallCurve`)."""
+        out = []
+        for i, t in enumerate(self.thresholds):
             denom_p = self.tp[i] + self.fp[i]
             denom_r = self.tp[i] + self.fn[i]
-            precs.append(self.tp[i] / denom_p if denom_p else 1.0)
-            recs.append(self.tp[i] / denom_r if denom_r else 0.0)
-        pairs = sorted(zip(recs, precs))
+            prec = self.tp[i] / denom_p if denom_p else 1.0
+            rec = self.tp[i] / denom_r if denom_r else 0.0
+            out.append((float(t), float(rec), float(prec)))
+        return out
+
+    def calculate_auprc(self) -> float:
+        """Area under precision-recall curve (trapezoid over the grid)."""
+        pts = self.get_precision_recall_curve()
+        pairs = sorted((r, p) for _, r, p in pts)
         auc = 0.0
         for (r0, p0), (r1, p1) in zip(pairs[:-1], pairs[1:]):
             auc += (r1 - r0) * (p1 + p0) / 2.0
@@ -97,6 +104,10 @@ class ROCMultiClass:
         m = None if mask is None else np.asarray(mask).reshape(-1)
         for i in range(c):
             self._rocs[i].eval(lab2[:, i], pr2[:, i], mask=m)
+
+    @property
+    def num_classes(self) -> int:
+        return len(self._rocs)
 
     def calculate_auc(self, cls: int) -> float:
         return self._rocs[cls].calculate_auc()

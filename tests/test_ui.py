@@ -181,3 +181,110 @@ def test_remote_endpoint_requires_enable():
             assert e.code == 403
     finally:
         srv.stop()
+
+
+def test_chart_components_json_and_svg():
+    """ui-components DSL parity: charts serialize to JSON and render SVG."""
+    import json as _json
+
+    from deeplearning4j_tpu.ui.components import (ChartHistogram, ChartLine,
+                                                  ChartScatter,
+                                                  ComponentTable,
+                                                  render_page)
+
+    line = (ChartLine("loss").add_series("train", [0, 1, 2], [3.0, 2.0, 1.5])
+            .add_series("val", [0, 1, 2], [3.5, 2.4, 2.0]))
+    d = _json.loads(line.to_json())
+    assert d["type"] == "chart-line" and len(d["series"]) == 2
+    svg = line.render_svg()
+    assert svg.count("<polyline") == 2 and "loss" in svg
+
+    sc = ChartScatter("emb").add_series("pts", [0.0, 1.0], [1.0, 0.0])
+    assert sc.render_svg().count("<circle") == 2
+
+    h = ChartHistogram("w").add_bin(0, 1, 5).add_bin(1, 2, 3)
+    assert h.render_svg().count("<rect") == 2
+
+    t = ComponentTable(["a", "b"], [[1, 2], [3, 4]])
+    assert "<table" in t.render_svg()
+
+    page = render_page("report", [line, t])
+    assert page.startswith("<!DOCTYPE html>") and "report" in page
+
+
+def test_evaluation_tools_roc_html_export(tmp_path):
+    """EvaluationTools.exportRocChartsToHtmlFile parity."""
+    import numpy as _np
+
+    from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+    from deeplearning4j_tpu.eval.tools import EvaluationTools
+
+    r = _np.random.default_rng(0)
+    labels = r.integers(0, 2, 200).astype(_np.float64)
+    probs = _np.clip(labels * 0.6 + r.normal(0, 0.25, 200), 0, 1)
+    roc = ROC(threshold_steps=50)
+    roc.eval(labels, probs)
+    path = str(tmp_path / "roc.html")
+    EvaluationTools.export_roc_charts_to_html_file(roc, path)
+    html = open(path).read()
+    assert "AUC=" in html and "<polyline" in html
+    assert f"{roc.calculate_auc():.4f}" in html
+
+    mc = ROCMultiClass(threshold_steps=25)
+    y = _np.eye(3)[r.integers(0, 3, 120)]
+    p = _np.abs(y * 0.5 + r.normal(0, 0.3, y.shape))
+    p = p / p.sum(1, keepdims=True)
+    mc.eval(y, p)
+    html2 = EvaluationTools.roc_multi_class_chart_html(mc)
+    assert html2.count("class ") >= 3
+
+
+def test_evaluation_tools_confusion_html(tmp_path):
+    import numpy as _np
+
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.eval.tools import EvaluationTools
+
+    ev = Evaluation(labels=["cat", "dog"])
+    ev.eval(_np.eye(2)[[0, 1, 0, 1]], _np.eye(2)[[0, 1, 1, 1]])
+    path = str(tmp_path / "cm.html")
+    EvaluationTools.export_confusion_matrix_html_file(ev, path)
+    html = open(path).read()
+    assert "cat" in html and "accuracy" in html
+
+
+def test_components_escape_html():
+    from deeplearning4j_tpu.ui.components import (ChartLine, ComponentTable,
+                                                  ComponentText, render_page)
+    t = ComponentTable(["a<b"], [["<=5&"]])
+    svg = t.render_svg()
+    assert "&lt;=5&amp;" in svg and "a&lt;b" in svg
+    assert "<p>x &lt; y</p>" in ComponentText("x < y").render_svg()
+    line = ChartLine("t&t").add_series("a<s", [0, 1], [0, 1])
+    assert "a&lt;s" in line.render_svg() and "t&amp;t" in line.render_svg()
+    page = render_page("p<q", [t])
+    assert "p&lt;q" in page
+
+
+def test_roc_precision_recall_curve_in_export(tmp_path):
+    """The PR chart must actually render (was dead code behind hasattr)."""
+    import numpy as _np
+
+    from deeplearning4j_tpu.eval.roc import ROC
+    from deeplearning4j_tpu.eval.tools import EvaluationTools
+    r = _np.random.default_rng(1)
+    labels = r.integers(0, 2, 100).astype(_np.float64)
+    probs = _np.clip(labels * 0.7 + r.normal(0, 0.2, 100), 0, 1)
+    roc = ROC(threshold_steps=20)
+    roc.eval(labels, probs)
+    assert len(roc.get_precision_recall_curve()) == len(roc.thresholds)
+    html = EvaluationTools.roc_chart_html(roc)
+    assert "Precision-Recall" in html and "AUPRC=" in html
+
+
+def test_confusion_export_empty_evaluation(tmp_path):
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.eval.tools import EvaluationTools
+    path = str(tmp_path / "empty.html")
+    EvaluationTools.export_confusion_matrix_html_file(Evaluation(), path)
+    assert "accuracy" in open(path).read()
