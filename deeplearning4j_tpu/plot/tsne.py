@@ -244,3 +244,25 @@ class BarnesHutTsne(Tsne):
 
     def get_data(self) -> np.ndarray:
         return self.y
+
+
+def export_tsne_html(coords, path: str, labels=None,
+                     title: str = "t-SNE"):
+    """Scatter-plot an embedding to a standalone HTML file (the reference
+    UI's TsneModule view, `module/tsne/TsneModule.java`), colored by label
+    when given."""
+    import numpy as _np
+
+    from ..ui.components import ChartScatter, StyleChart, render_page
+
+    coords = _np.asarray(coords)
+    chart = ChartScatter(title, StyleChart(600, 440))
+    if labels is None:
+        chart.add_series("points", coords[:, 0], coords[:, 1])
+    else:
+        labels = _np.asarray(labels)
+        for lab in _np.unique(labels):
+            m = labels == lab
+            chart.add_series(str(lab), coords[m, 0], coords[m, 1])
+    with open(path, "w") as f:
+        f.write(render_page(title, [chart]))

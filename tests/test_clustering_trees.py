@@ -140,3 +140,18 @@ def test_barnes_hut_get_data_and_export(tmp_path):
     out = tmp_path / "tsne.csv"
     ts.save_as_file([str(l) for l in labels], str(out))
     assert len(out.read_text().splitlines()) == 45
+
+
+def test_export_tsne_html(tmp_path):
+    """TsneModule-analog scatter export, colored by label."""
+    import numpy as np
+
+    from deeplearning4j_tpu.plot.tsne import export_tsne_html
+    r = np.random.default_rng(0)
+    coords = r.normal(size=(30, 2))
+    labels = r.integers(0, 3, 30)
+    path = str(tmp_path / "tsne.html")
+    export_tsne_html(coords, path, labels=labels, title="emb<1>")
+    html = open(path).read()
+    assert html.count("<circle") == 30
+    assert "emb&lt;1&gt;" in html
