@@ -21,7 +21,7 @@ import numpy as np
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
-    "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "ArrayDataSetIterator", "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "IteratorDataSetIterator",
     "ExistingDataSetIterator",
 ]
@@ -469,3 +469,10 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self.source.batch()
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background-thread prefetch over a MULTI-dataset iterator (parity
+    with `datasets/iterator/AsyncMultiDataSetIterator.java`) — the prefetch
+    machinery is payload-agnostic, so this is the naming/type marker for
+    MultiDataSet sources feeding a ComputationGraph."""

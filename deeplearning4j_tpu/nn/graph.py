@@ -109,8 +109,11 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward_values(self, params, state, inputs: Dict[str, Any], train,
                         rng, fmasks: Optional[Dict[str, Any]] = None,
-                        stop_at_outputs: bool = False):
-        """Execute vertices in topo order. Returns (values, masks, new_state).
+                        stop_at_outputs: bool = False, carries=None):
+        """Execute vertices in topo order. Returns (values, masks, new_state)
+        — or (values, masks, new_state, new_carries) when `carries` (a dict
+        keyed by recurrent vertex name) is given, for stateful streaming
+        inference (reference ComputationGraph.rnnTimeStep).
         Output-layer vertices contribute their *pre-activation input* (the
         caller applies loss or activation)."""
         cdt = self._compute_dtype
@@ -119,6 +122,7 @@ class ComputationGraph:
                           if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
                           else v) for k, v in inputs.items()}
         values: Dict[str, Any] = dict(inputs)
+        new_carries: Dict[str, Any] = {}
         masks: Dict[str, Any] = dict(fmasks or {})
         for k in self.conf.network_inputs:
             masks.setdefault(k, None)
@@ -149,13 +153,21 @@ class ComputationGraph:
                 # layers keep master-dtype params (see MultiLayerNetwork).
                 if cdt is not None and not isinstance(v, BaseOutputLayerConf):
                     p_v = cast_floating(p_v, cdt)
-                y, new_state[name] = v.apply(p_v, state[name], x,
-                                             train=train, rng=rngs[i], mask=m)
+                if carries is not None and getattr(v, "is_recurrent", False):
+                    (y, new_carries[name]), new_state[name] = v.apply(
+                        p_v, state[name], x, train=train, rng=rngs[i],
+                        mask=m, carry=carries.get(name), return_carry=True)
+                else:
+                    y, new_state[name] = v.apply(p_v, state[name], x,
+                                                 train=train, rng=rngs[i],
+                                                 mask=m)
                 values[name] = y
                 masks[name] = v.output_mask(m)
             else:
                 values[name] = v.apply(ins, in_masks)
                 masks[name] = v.output_mask(in_masks)
+        if carries is not None:
+            return values, masks, new_state, new_carries
         return values, masks, new_state
 
     def _loss_fn(self, params, state, inputs, labels, rng, fmasks=None,
@@ -243,18 +255,23 @@ class ComputationGraph:
             values, masks, _ = self._forward_values(
                 params, state, inputs, False, None, fmasks,
                 stop_at_outputs=True)
-            outs = []
-            for name in self.conf.network_outputs:
-                v = self.conf.vertices[name]
-                if isinstance(v, BaseOutputLayerConf):
-                    x, m = values[name]
-                    y, _ = v.apply(params[name], state[name], x, train=False,
-                                   rng=None, mask=m)
-                else:
-                    y = values[name]
-                outs.append(y)
-            return tuple(outs)
+            return self._collect_outputs(params, state, values)
         return jax.jit(predict)
+
+    def _collect_outputs(self, params, state, values):
+        """Activate the network outputs from forward values (shared by the
+        predict and rnn-step paths)."""
+        outs = []
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if isinstance(v, BaseOutputLayerConf):
+                x, m = values[name]
+                y, _ = v.apply(params[name], state[name], x, train=False,
+                               rng=None, mask=m)
+            else:
+                y = values[name]
+            outs.append(y)
+        return tuple(outs)
 
     @functools.cached_property
     def _score_fn(self):
@@ -351,6 +368,56 @@ class ComputationGraph:
 
     def output_single(self, *features, **kw):
         return self.output(*features, **kw)[0]
+
+    # -- stateful RNN inference (reference ComputationGraph.rnnTimeStep) --
+    @functools.cached_property
+    def _rnn_step_fn(self):
+        def step(params, state, inputs, carries):
+            values, masks, _, new_carries = self._forward_values(
+                params, state, inputs, False, None, None,
+                stop_at_outputs=True, carries=carries)
+            return self._collect_outputs(params, state, values), new_carries
+        return jax.jit(step)
+
+    def rnn_time_step(self, *features):
+        """Feed one (or a few) timesteps through the graph, carrying hidden
+        state of every recurrent vertex across calls. 2-D inputs are
+        treated as single timesteps per input (mixed-rank multi-input
+        graphs keep their static inputs 2-D)."""
+        if self.params is None:
+            self.init()
+        xs = [jnp.asarray(f) for f in features]
+        squeeze = xs[0].ndim == 2
+        xs = [x[:, None, :] if x.ndim == 2 else x for x in xs]
+        inputs = dict(zip(self.conf.network_inputs, xs))
+        batch = int(xs[0].shape[0])
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is not None:
+            cached_batch = jax.tree_util.tree_leaves(carries)[0].shape[0]
+            if cached_batch != batch:
+                raise ValueError(
+                    f"rnn_time_step batch changed from {cached_batch} to "
+                    f"{batch}; call rnn_clear_previous_state() first")
+        if carries is None:
+            rec = {name: v for name, v in self.conf.vertices.items()
+                   if getattr(v, "is_recurrent", False)}
+            not_stepable = [n for n, v in rec.items()
+                            if not hasattr(v, "init_carry")]
+            if not_stepable:
+                raise ValueError(
+                    f"rnn_time_step unsupported for vertices "
+                    f"{not_stepable} (bidirectional layers need the full "
+                    "sequence — the reference rejects these too)")
+            carries = {name: v.init_carry(batch, xs[0].dtype)
+                       for name, v in rec.items()}
+        outs, self._rnn_carries = self._rnn_step_fn(
+            self.params, self.state, inputs, carries)
+        if squeeze:
+            outs = tuple(o[:, 0] if o.ndim == 3 else o for o in outs)
+        return outs if len(outs) > 1 else outs[0]
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
 
     def score(self, ds=None) -> float:
         if ds is None:

@@ -1,0 +1,87 @@
+"""ComputationGraph stateful RNN inference (reference
+`ComputationGraph.rnnTimeStep`) + AsyncMultiDataSetIterator.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (Adam, NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.iterators import (AsyncMultiDataSetIterator,
+                                                   ExistingDataSetIterator,
+                                                   MultiDataSet)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+
+def _lstm_graph(vocab=7, hidden=12, seq=10, seed=3):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(vocab, seq)))
+    b.add_layer("lstm", GravesLSTM(n_out=hidden, activation="tanh"), "in")
+    b.add_layer("out", RnnOutputLayer(n_out=vocab, activation="softmax",
+                                      loss="mcxent"), "lstm")
+    return ComputationGraph(b.set_outputs("out").build()).init()
+
+
+def test_graph_rnn_time_step_matches_full_sequence():
+    """Feeding a sequence one step at a time with carried state must equal
+    the full-sequence forward at every timestep."""
+    g = _lstm_graph()
+    r = np.random.default_rng(0)
+    idx = r.integers(0, 7, (2, 10))
+    x = np.eye(7, dtype=np.float32)[idx]
+    full = np.asarray(g.output(jnp.asarray(x))[0])      # [B, T, V]
+    g.rnn_clear_previous_state()
+    step_outs = []
+    for t in range(10):
+        o = g.rnn_time_step(x[:, t])                    # [B, V]
+        step_outs.append(np.asarray(o))
+    stepped = np.stack(step_outs, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=2e-4, atol=1e-5)
+    # clearing state restarts the recurrence
+    g.rnn_clear_previous_state()
+    again = np.asarray(g.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(again, stepped[:, 0], rtol=2e-4, atol=1e-5)
+
+
+def test_async_multi_dataset_iterator_prefetches():
+    r = np.random.default_rng(1)
+    batches = [MultiDataSet(features=[r.normal(size=(4, 3)).astype(np.float32)],
+                            labels=[r.normal(size=(4, 2)).astype(np.float32)])
+               for _ in range(5)]
+    it = AsyncMultiDataSetIterator(ExistingDataSetIterator(batches))
+    got = []
+    while it.has_next():
+        got.append(it.next())
+    assert len(got) == 5
+    np.testing.assert_array_equal(got[0].features[0], batches[0].features[0])
+
+
+def test_graph_rnn_time_step_batch_change_rejected():
+    g = _lstm_graph()
+    x = np.eye(7, dtype=np.float32)[np.zeros((4,), np.int64)]
+    g.rnn_time_step(x)
+    import pytest
+    with pytest.raises(ValueError, match="batch changed"):
+        g.rnn_time_step(x[:2])
+    g.rnn_clear_previous_state()
+    g.rnn_time_step(x[:2])   # fine after clearing
+
+
+def test_graph_rnn_time_step_rejects_bidirectional():
+    from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(5, 6)))
+    b.add_layer("bi", GravesBidirectionalLSTM(n_out=8, activation="tanh"),
+                "in")
+    b.add_layer("out", RnnOutputLayer(n_out=5, activation="softmax",
+                                      loss="mcxent"), "bi")
+    g = ComputationGraph(b.set_outputs("out").build()).init()
+    import pytest
+    x = np.zeros((2, 5), np.float32)
+    with pytest.raises(ValueError, match="bidirectional|full sequence"):
+        g.rnn_time_step(x)
