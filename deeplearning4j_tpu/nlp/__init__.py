@@ -16,6 +16,7 @@ from .word2vec import ParagraphVectors, SequenceVectors, Word2Vec
 from .glove import CoOccurrences, Glove
 from .serializer import WordVectorSerializer
 from .bow import BagOfWordsVectorizer, TfidfVectorizer
+from .invertedindex import InvertedIndex
 
 __all__ = [
     "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
@@ -29,5 +30,5 @@ __all__ = [
     "InMemoryLookupTable", "NegativeSampler", "WordVectorsModel",
     "ParagraphVectors", "SequenceVectors", "Word2Vec",
     "CoOccurrences", "Glove", "WordVectorSerializer",
-    "BagOfWordsVectorizer", "TfidfVectorizer",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex",
 ]

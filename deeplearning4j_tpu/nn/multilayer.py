@@ -558,10 +558,46 @@ class MultiLayerNetwork:
                     "ArrayDataSetIterator(drop_last=True) or pad batches "
                     "to a fixed size", sig)
 
+    def _check_input_width(self, x):
+        """Fail with a named error instead of a raw XLA shape error when the
+        input shape doesn't match the configured InputType."""
+        it = getattr(self.conf, "input_type", None)
+        if it is None:
+            return
+        kind = getattr(it, "kind", None)
+        if kind == "ff":
+            if x.ndim >= 2 and x.shape[-1] != it.flat_size():
+                raise ValueError(
+                    f"input width {x.shape[-1]} != configured "
+                    f"InputType.feed_forward({it.flat_size()})")
+        elif kind == "rnn":
+            if x.ndim == 3 and x.shape[-1] != it.size:
+                raise ValueError(
+                    f"input feature size {x.shape[-1]} != configured "
+                    f"InputType.recurrent({it.size}, ...)")
+            if x.ndim == 2:
+                raise ValueError(
+                    "recurrent network input must be 3-D [batch, time, "
+                    f"features]; got 2-D {tuple(x.shape)} (use "
+                    "rnn_time_step for single-step inference)")
+        elif kind == "cnn":
+            if x.ndim == 4 and tuple(x.shape[1:]) != (it.height, it.width,
+                                                      it.channels):
+                raise ValueError(
+                    f"input shape {tuple(x.shape[1:])} != configured "
+                    f"InputType.convolutional({it.height}, {it.width}, "
+                    f"{it.channels}) (NHWC)")
+        elif kind in ("cnn_flat", "cnn1d"):
+            if x.ndim == 2 and x.shape[-1] != it.flat_size():
+                raise ValueError(
+                    f"input width {x.shape[-1]} != configured "
+                    f"{kind} InputType flat size {it.flat_size()}")
+
     def _fit_batch(self, ds: DataSet):
         from .conf import OptimizationAlgorithm as OA
 
         x, y, fmask, lmask = ds.device_tuple()
+        self._check_input_width(x)
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and x.ndim == 3):
             # TBPTT traces per-chunk shapes; _fit_tbptt tracks those
@@ -717,6 +753,7 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         x = jnp.asarray(x)
+        self._check_input_width(x)
         fm = None if features_mask is None else jnp.asarray(features_mask)
         return self._predict_fn(self.params, self.state, x, fm)
 

@@ -142,3 +142,56 @@ def test_frozen_layer_not_updated(classification_data):
     np.testing.assert_array_equal(np.asarray(model.params[0]["W"]), w_before)
     # but output layer did move
     assert not np.allclose(np.asarray(model.params[1]["W"]), out_before)
+
+
+def test_wrong_input_width_named_error():
+    """Wrong feature width fails with a named ValueError, not a raw XLA
+    shape error (verify-skill rough edge, now fixed)."""
+    import pytest
+
+    from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    bad = np.zeros((4, 7), np.float32)
+    with pytest.raises(ValueError, match="input width 7"):
+        net.output(bad)
+    with pytest.raises(ValueError, match="input width 7"):
+        net.fit(DataSet(bad, np.zeros((4, 2), np.float32)))
+
+
+def test_cnn_and_rnn_input_shape_named_errors():
+    import pytest
+
+    from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              ConvolutionMode, GravesLSTM,
+                                              RnnOutputLayer)
+    cnn_conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        stride=(1, 1), activation="relu",
+                                        convolution_mode=ConvolutionMode.SAME))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 3)).build())
+    cnet = MultiLayerNetwork(cnn_conf).init()
+    with pytest.raises(ValueError, match="NHWC"):
+        cnet.output(np.zeros((2, 8, 8, 4), np.float32))
+    rnn_conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(GravesLSTM(n_out=6, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(5, 7)).build())
+    rnet = MultiLayerNetwork(rnn_conf).init()
+    with pytest.raises(ValueError, match="3-D"):
+        rnet.output(np.zeros((2, 5), np.float32))
+    with pytest.raises(ValueError, match="feature size 9"):
+        rnet.output(np.zeros((2, 7, 9), np.float32))

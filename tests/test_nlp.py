@@ -272,3 +272,33 @@ def test_word2vec_tiny_vocab_stays_finite():
     m = w2v.lookup_table.vectors_matrix()
     assert np.all(np.isfinite(m)), "embeddings diverged"
     assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "wheel") + 0.1
+
+
+def test_inverted_index_search_and_phrase():
+    """Inverted index (text/invertedindex Lucene analog): TF-IDF ranked
+    search, positional phrase queries, postings bookkeeping."""
+    from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+
+    idx = InvertedIndex()
+    d0 = idx.add_document("the cat sat on the mat", label="a")
+    d1 = idx.add_document("the dog chased the cat", label="b")
+    d2 = idx.add_document("stocks fell on monday trading", label="c")
+    assert idx.num_documents() == 3
+    assert idx.document_frequency("the") == 2
+    assert idx.term_frequency("the", d0) == 2
+    assert idx.documents_containing("cat") == [d0, d1]
+    assert idx.label(d1) == "b"
+
+    hits = idx.search("cat mat")
+    assert hits[0][0] == d0          # both terms -> best match
+    assert {h[0] for h in hits} == {d0, d1}
+    hits2 = idx.search("monday stocks")
+    assert hits2[0][0] == d2
+
+    assert idx.phrase_search("the cat") == [d0, d1]
+    assert idx.phrase_search("cat sat") == [d0]
+    assert idx.phrase_search("sat cat") == []
+    assert idx.phrase_search("dog chased the cat") == [d1]
+
+    batches = list(idx.batch_iter(2))
+    assert [len(b) for b in batches] == [2, 1]
