@@ -26,11 +26,17 @@ class WordVectorSerializer:
     # --------------------------- text ---------------------------------
     @staticmethod
     def _open_text(path: str, mode: str):
-        """Transparent gzip for .gz paths (the reference's
-        readWord2VecVectors gzip support in WordVectorSerializer)."""
-        if path.endswith(".gz"):
-            import gzip
+        """Transparent gzip (the reference's readWord2VecVectors gzip
+        support): .gz extension on write; gzip MAGIC on read, so renamed
+        .gz files still load."""
+        import gzip
 
+        if "r" in mode:
+            with open(path, "rb") as probe:
+                if probe.read(2) == b"\x1f\x8b":
+                    return gzip.open(path, mode + "t", encoding="utf-8")
+            return open(path, mode, encoding="utf-8")
+        if path.endswith(".gz"):
             return gzip.open(path, mode + "t", encoding="utf-8")
         return open(path, mode, encoding="utf-8")
 

@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-__all__ = ["ModelSerializer", "tree_to_arrays", "arrays_to_tree"]
+__all__ = ["ModelSerializer", "ModelGuesser", "tree_to_arrays", "arrays_to_tree"]
 
 
 def tree_to_arrays(tree) -> Dict[str, np.ndarray]:
@@ -160,3 +160,91 @@ class ModelSerializer:
         if meta.get("kind") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)
+
+
+class ModelGuesser:
+    """Format sniffing + dispatch loading (reference
+    `deeplearning4j-core/.../util/ModelGuesser.java`): given an arbitrary
+    model file, detect what it is and restore it with the right loader.
+
+    Recognized: our ModelSerializer zips (MultiLayerNetwork vs
+    ComputationGraph via the config JSON), Keras HDF5 models
+    (sequential/functional via modelimport), and word-vector files
+    (Google binary / text) -> WordVectorsModel."""
+
+    @staticmethod
+    def _sniff_vector_bytes(head: bytes) -> Optional[str]:
+        """Classify a word-vector payload from its first bytes."""
+        try:
+            first_line, _, rest = head.partition(b"\n")
+            tokens = first_line.decode("utf-8").strip().split()
+        except UnicodeDecodeError:
+            return None
+        if len(tokens) == 2 and all(t.isdigit() for t in tokens):
+            # "<V> <D>\n" header: Google binary OR text-with-header.
+            # Binary payload after the word is raw f32; text stays ASCII.
+            printable = sum(32 <= b < 127 or b in (9, 10, 13)
+                            for b in rest)
+            return ("word_vectors_text" if rest and
+                    printable / len(rest) > 0.95 else
+                    "word_vectors_binary")
+        if len(tokens) >= 2:
+            try:
+                float(tokens[1])
+                return "word_vectors_text"
+            except ValueError:
+                return None
+        return None
+
+    @staticmethod
+    def guess_format(path: str) -> str:
+        with open(path, "rb") as f:
+            head = f.read(4096)
+        if head[:4] == b"PK\x03\x04":
+            with zipfile.ZipFile(path) as z:
+                names = set(z.namelist())
+            if "configuration.json" in names:
+                return "dl4j_tpu_zip"
+            if "syn0.txt" in names and "config.json" in names:
+                return "word_vectors_zip"
+            return "unknown_zip"
+        if head[:8] == b"\x89HDF\r\n\x1a\n":
+            return "keras_h5"
+        if head[:2] == b"\x1f\x8b":
+            # gzipped text vectors (read_word_vectors sniffs gzip magic)
+            import gzip
+            import io as _io
+            try:
+                inner = gzip.GzipFile(fileobj=_io.BytesIO(head)) \
+                    .read(1024)
+            except (OSError, EOFError):
+                return "unknown"
+            kind = ModelGuesser._sniff_vector_bytes(inner)
+            return kind or "unknown"
+        kind = ModelGuesser._sniff_vector_bytes(head)
+        return kind or "unknown"
+
+    @staticmethod
+    def load(path: str):
+        kind = ModelGuesser.guess_format(path)
+        if kind == "dl4j_tpu_zip":
+            return ModelSerializer.restore(path)
+        if kind == "keras_h5":
+            from ..modelimport.keras import (
+                KerasImportError, import_keras_model_and_weights,
+                import_keras_sequential_model_and_weights)
+            try:
+                return import_keras_sequential_model_and_weights(path)
+            except KerasImportError as e:
+                if "Not a Sequential model" not in str(e):
+                    raise   # keep the actionable sequential-import error
+                return import_keras_model_and_weights(path)
+        from ..nlp.serializer import WordVectorSerializer
+        if kind == "word_vectors_binary":
+            return WordVectorSerializer.read_binary(path)
+        if kind == "word_vectors_text":
+            return WordVectorSerializer.read_word_vectors(path)
+        if kind == "word_vectors_zip":
+            return WordVectorSerializer.read_word2vec_model(path)
+        raise ValueError(f"cannot determine model format of {path!r} "
+                         f"(sniffed: {kind})")

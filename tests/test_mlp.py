@@ -195,3 +195,91 @@ def test_cnn_and_rnn_input_shape_named_errors():
         rnet.output(np.zeros((2, 5), np.float32))
     with pytest.raises(ValueError, match="feature size 9"):
         rnet.output(np.zeros((2, 7, 9), np.float32))
+
+
+def test_model_guesser_sniffs_and_loads(tmp_path):
+    """ModelGuesser (reference ModelGuesser.java): format sniffing +
+    dispatch loading for checkpoint zips and word-vector files."""
+    import pytest
+
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.util.serializer import (ModelGuesser,
+                                                    ModelSerializer)
+
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    zpath = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, zpath)
+    assert ModelGuesser.guess_format(zpath) == "dl4j_tpu_zip"
+    loaded = ModelGuesser.load(zpath)
+    x = np.zeros((3, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
+
+    # word vectors: text + google binary
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+    from deeplearning4j_tpu.nlp.embeddings import (InMemoryLookupTable,
+                                                   WordVectorsModel)
+    vc = VocabCache()
+    for w in ("alpha", "beta"):
+        vc.add_token(VocabWord(w, 1))
+    vc.update_indices()
+    table = InMemoryLookupTable(vc, 4, negative=0)
+    model = WordVectorsModel(vc, table)
+    tpath = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(model, tpath)
+    assert ModelGuesser.guess_format(tpath) == "word_vectors_text"
+    wv = ModelGuesser.load(tpath)
+    assert wv.has_word("alpha")
+    bpath = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(model, bpath)
+    assert ModelGuesser.guess_format(bpath) == "word_vectors_binary"
+    assert ModelGuesser.load(bpath).has_word("beta")
+
+    junk = str(tmp_path / "junk.dat")
+    open(junk, "wb").write(b"\x00\x01\x02\x03 junk")
+    with pytest.raises(ValueError, match="cannot determine"):
+        ModelGuesser.load(junk)
+
+
+def test_model_guesser_zip_header_and_gz_variants(tmp_path):
+    """Guesser edge cases from review: word2vec zips, text-with-header
+    (not binary!), gzipped text (even without a .gz extension)."""
+    from deeplearning4j_tpu.nlp.embeddings import (InMemoryLookupTable,
+                                                   WordVectorsModel)
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+    from deeplearning4j_tpu.util.serializer import ModelGuesser
+
+    vc = VocabCache()
+    for w in ("alpha", "beta"):
+        vc.add_token(VocabWord(w, 1))
+    vc.update_indices()
+    model = WordVectorsModel(vc, InMemoryLookupTable(vc, 4, negative=0))
+
+    zp = str(tmp_path / "w2v.zip")
+    WordVectorSerializer.write_word2vec_model(model, zp)
+    assert ModelGuesser.guess_format(zp) == "word_vectors_zip"
+    assert ModelGuesser.load(zp).has_word("alpha")
+
+    hp = str(tmp_path / "hdr.txt")
+    WordVectorSerializer.write_word_vectors(model, hp, header=True)
+    assert ModelGuesser.guess_format(hp) == "word_vectors_text"
+    loaded = ModelGuesser.load(hp)
+    np.testing.assert_allclose(loaded.word_vector("alpha"),
+                               model.word_vector("alpha"), atol=1e-5)
+
+    gz = str(tmp_path / "vecs.txt.gz")
+    WordVectorSerializer.write_word_vectors(model, gz)
+    import shutil
+    renamed = str(tmp_path / "renamed.dat")   # gz content, no extension
+    shutil.copy(gz, renamed)
+    assert ModelGuesser.guess_format(renamed) == "word_vectors_text"
+    assert ModelGuesser.load(renamed).has_word("beta")
