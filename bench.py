@@ -70,6 +70,9 @@ def bench_scaling(devices=8):
 
 
 def main():
+    from deeplearning4j_tpu.util.platform import enable_compilation_cache
+    enable_compilation_cache()   # reuse XLA executables across bench runs
+
     from deeplearning4j_tpu.models.zoo import (bench_char_rnn, bench_lenet,
                                                bench_resnet50)
 

@@ -71,3 +71,24 @@ def provision_virtual_devices(n_devices: int) -> bool:
                 os.environ.pop(key, None)
             else:
                 os.environ[key] = old
+
+
+def enable_compilation_cache(cache_dir: str = None,
+                             min_compile_secs: float = 1.0) -> bool:
+    """Enable JAX's persistent compilation cache (standard JAX feature):
+    compiled executables are reused across processes, so repeated runs of
+    benches/jobs skip XLA compilation. Safe to call multiple times."""
+    import os
+
+    import jax
+
+    try:
+        cache_dir = cache_dir or os.path.join(
+            os.path.expanduser("~"), ".deeplearning4j_tpu", "jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        return True
+    except Exception:
+        return False
