@@ -34,6 +34,7 @@ th{background:#eee} svg{background:#fff;border:1px solid #ddd}
 <div id="meta"></div>
 <div><span class="tab active" data-p="overview">Overview</span>
 <span class="tab" data-p="model">Model</span>
+<span class="tab" data-p="histograms">Histograms</span>
 <span class="tab" data-p="system">System</span></div>
 <div id="content"></div>
 <script>
@@ -41,6 +42,21 @@ let page='overview';
 document.querySelectorAll('.tab').forEach(t=>t.onclick=()=>{
   document.querySelectorAll('.tab').forEach(x=>x.classList.remove('active'));
   t.classList.add('active'); page=t.dataset.p; refresh();});
+function bars(hist,w,h,color){
+  if(!hist||!hist.counts||!hist.counts.length)
+    return '<svg width="'+w+'" height="'+h+'"></svg>';
+  const n=hist.counts.length, mx=Math.max(...hist.counts)||1;
+  let s='<svg width="'+w+'" height="'+h+'">';
+  const bw=(w-40)/n;
+  hist.counts.forEach((c,i)=>{
+    const bh=c/mx*(h-24);
+    s+='<rect x="'+(20+i*bw)+'" y="'+(h-12-bh)+'" width="'+Math.max(1,bw-1)+
+      '" height="'+bh+'" fill="'+color+'" fill-opacity="0.85"/>';});
+  s+='<text x="4" y="'+(h-2)+'" font-size="9">'+hist.min.toPrecision(3)+
+    '</text><text x="'+(w-4)+'" y="'+(h-2)+'" text-anchor="end" font-size="9">'+
+    hist.max.toPrecision(3)+'</text></svg>';
+  return s;
+}
 function line(xs,ys,w,h,color){
   if(ys.length<2) return '<svg width="'+w+'" height="'+h+'"></svg>';
   const mn=Math.min(...ys), mx=Math.max(...ys), sp=(mx-mn)||1;
@@ -72,6 +88,15 @@ async function refresh(){
     html+='</table>';
     html+='<h2>Mean parameter stdev vs iteration</h2>'+
       line(d.iterations,d.param_stdev,640,140,'#393');
+  } else if(page=='histograms'){
+    for(const [k,v] of Object.entries(d.params)){
+      html+='<h2>'+k+'</h2>'+bars(v.histogram,320,110,'#36c');
+      if(d.updates[k])
+        html+=' '+bars(d.updates[k].histogram,320,110,'#c63');
+    }
+    if(!Object.keys(d.params).length)
+      html+='<p>no parameter histograms collected '+
+        '(StatsListener(collect_histograms=True))</p>';
   } else {
     html+='<h2>Host RSS (MB)</h2>'+line(d.iterations,d.rss_mb,640,140,'#939');
   }

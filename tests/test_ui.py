@@ -288,3 +288,45 @@ def test_confusion_export_empty_evaluation(tmp_path):
     path = str(tmp_path / "empty.html")
     EvaluationTools.export_confusion_matrix_html_file(Evaluation(), path)
     assert "accuracy" in open(path).read()
+
+
+def test_dashboard_page_has_histogram_tab_and_payload():
+    """HistogramModule analog: the dashboard serves a Histograms tab with a
+    bar renderer, and data.json carries per-param histograms."""
+    import json as _json
+    import urllib.request
+
+    import numpy as _np
+
+    from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.add_listeners(StatsListener(storage, frequency=1))
+    r = _np.random.default_rng(0)
+    x = r.normal(size=(16, 4)).astype(_np.float32)
+    y = _np.eye(2, dtype=_np.float32)[r.integers(0, 2, 16)]
+    for _ in range(3):
+        net.fit(DataSet(x, y))
+    srv = UIServer(port=0).attach(storage).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        page = urllib.request.urlopen(base + "/train", timeout=10) \
+            .read().decode()
+        assert 'data-p="histograms"' in page and "function bars(" in page
+        d = _json.load(urllib.request.urlopen(base + "/train/data.json",
+                                              timeout=10))
+        hist = next(iter(d["params"].values()))["histogram"]
+        assert hist["counts"] and hist["min"] <= hist["max"]
+    finally:
+        srv.stop()
