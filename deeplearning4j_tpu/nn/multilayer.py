@@ -472,6 +472,7 @@ class MultiLayerNetwork:
                 xs_d, ys_d, fm_d, lm_d, fs_d,
                 carries0 if tbptt else (), k)
             self.last_batch_size = int(xs_d.shape[1])
+            self.last_input = xs_d[-1]   # last scanned batch, for listeners
             n_steps = int(xs_d.shape[0])
             if self.listeners:
                 host_scores = np.asarray(scores)
@@ -598,6 +599,8 @@ class MultiLayerNetwork:
 
         x, y, fmask, lmask = ds.device_tuple()
         self._check_input_width(x)
+        self.last_input = x   # reference setInput keeps the batch around;
+        # listeners (e.g. ConvolutionalIterationListener) read it
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and x.ndim == 3):
             # TBPTT traces per-chunk shapes; _fit_tbptt tracks those

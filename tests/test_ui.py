@@ -330,3 +330,57 @@ def test_dashboard_page_has_histogram_tab_and_payload():
         assert hist["counts"] and hist["min"] <= hist["max"]
     finally:
         srv.stop()
+
+
+def test_convolutional_listener_stores_activation_grids():
+    """ConvolutionalListenerModule analog: first-conv activation grids are
+    PNG-encoded onto the stats stream every N iterations."""
+    import base64
+    import io
+
+    import numpy as _np
+    import pytest as _pytest
+
+    PIL = _pytest.importorskip("PIL")
+    from PIL import Image
+
+    from deeplearning4j_tpu import (Adam, DataSet, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              ConvolutionMode)
+    from deeplearning4j_tpu.ui.convolutional import (
+        ConvolutionalIterationListener, activation_grid)
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    # tiler: 5 channels of h=4,w=3 -> 2 rows x 3 cols grid with 1px padding
+    g = activation_grid(_np.random.default_rng(0)
+                        .normal(size=(4, 3, 5)).astype(_np.float32))
+    assert g.dtype == _np.uint8 and g.shape == (2 * 5 - 1, 3 * 4 - 1)
+
+    storage = InMemoryStatsStorage()
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                    stride=(1, 1), activation="relu",
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    lis = ConvolutionalIterationListener(storage, frequency=2,
+                                         session_id="conv-test")
+    net.add_listeners(lis)
+    r = _np.random.default_rng(1)
+    x = r.normal(size=(4, 8, 8, 1)).astype(_np.float32)
+    y = _np.eye(2, dtype=_np.float32)[r.integers(0, 2, 4)]
+    for _ in range(4):
+        net.fit(DataSet(x, y))
+    ups = storage.get_all_updates("conv-test", "activations", "worker-0")
+    assert len(ups) == 2                      # iterations 2 and 4
+    _, report = ups[-1]
+    png = base64.b64decode(report["pngs_base64"][0])
+    img = Image.open(io.BytesIO(png))
+    # 6 conv channels of 8x8 tile to a 2-row x 3-col grid with 1px pad:
+    # width 3*9-1=26, height 2*9-1=17 — pins CONV activations, not the
+    # (8x8x1) input image, as the rendered payload
+    assert img.mode == "L" and img.size == (26, 17)
