@@ -117,11 +117,21 @@ class BinaryRecordDataSetIterator(DataSetIterator):
 
     def __init__(self, path: str, feature_shape: Sequence[int],
                  num_classes: int, batch_size: int, label_bytes: int = 1,
-                 header_bytes: int = 0):
+                 header_bytes: int = 0,
+                 label_byte_index: Optional[int] = None):
         self.feature_shape = tuple(int(s) for s in feature_shape)
         self.num_classes = int(num_classes)
         self.batch_size = int(batch_size)
         self.label_bytes = int(label_bytes)
+        # default: last label byte — byte 0 for CIFAR-10, the fine label for
+        # CIFAR-100's coarse+fine pair
+        self.label_byte_index = (self.label_bytes - 1
+                                 if label_byte_index is None
+                                 else int(label_byte_index))
+        if not 0 <= self.label_byte_index < self.label_bytes:
+            raise ValueError(
+                f"label_byte_index {self.label_byte_index} outside the "
+                f"{self.label_bytes} label byte(s)")
         feat_bytes = int(np.prod(self.feature_shape))
         self.reader = BinaryRecordReader(
             path, (self.label_bytes + feat_bytes,), np.uint8,
@@ -143,7 +153,7 @@ class BinaryRecordDataSetIterator(DataSetIterator):
         if not self.has_next():
             raise StopIteration
         raw, self._peek = self._peek, None
-        labels = raw[:, 0].astype(np.int64)
+        labels = raw[:, self.label_byte_index].astype(np.int64)
         feats = raw[:, self.label_bytes:].astype(np.float32) / 255.0
         x = feats.reshape((-1,) + self.feature_shape)
         y = np.eye(self.num_classes, dtype=np.float32)[labels]

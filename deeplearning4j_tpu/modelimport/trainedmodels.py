@@ -33,6 +33,22 @@ class TrainedModels:
     VGG16 = "vgg16"
 
 
+def _natural_key(s):
+    import re as _re
+    return [int(t) if t.isdigit() else t for t in _re.split(r"(\d+)", s)]
+
+
+def _check_order_safe(names, where: str):
+    """Alphabetical h5 iteration must equal natural order at EVERY level,
+    else default-named children (dense_2 ... dense_10) silently pair
+    kernels/biases out of order."""
+    if sorted(names) != sorted(names, key=_natural_key):
+        raise ValueError(
+            f"HDF5 names under {where!r} are not ordering-safe (numeric "
+            "suffixes sort differently alphabetically vs naturally); use "
+            "the full-model modelimport.keras path instead")
+
+
 def _collect_weight_pairs(h5file) -> List[Tuple[np.ndarray, np.ndarray]]:
     """Walk an HDF5 weights file and return (kernel, bias) pairs in
     traversal order. Handles both the legacy keras-applications layout
@@ -45,7 +61,22 @@ def _collect_weight_pairs(h5file) -> List[Tuple[np.ndarray, np.ndarray]]:
 
     def walk(group):
         kernel = None
-        for key in group:
+        # legacy Keras files record the TRUE order in h5 attrs
+        # (layer_names at the root, weight_names per layer group) — prefer
+        # that; only fall back to alphabetical iteration (with the
+        # natural-order safety check) when the attrs are absent (Keras 3)
+        keys = None
+        attrs = getattr(group, "attrs", {})
+        for attr in ("layer_names", "weight_names"):
+            if attr in attrs:
+                names = [n.decode() if isinstance(n, bytes) else str(n)
+                         for n in attrs[attr]]
+                keys = [n for n in names if n in group]
+                break
+        if keys is None:
+            keys = list(group)
+            _check_order_safe(keys, getattr(group, "name", "/"))
+        for key in keys:
             item = group[key]
             if isinstance(item, h5py.Group):
                 walk(item)
@@ -80,20 +111,9 @@ def assign_keras_weights_in_order(net, h5_path: str):
                 "Keras 3 .weights.h5 layout detected; save the FULL model "
                 "(.h5/.keras) and use modelimport.keras import functions, "
                 "or use a legacy keras-applications weight file here")
-        # alphabetical h5 iteration must equal natural order, else
-        # default-named files (conv2d_2 ... conv2d_10) silently misassign
-        import re as _re
-
-        def natural(s):
-            return [int(t) if t.isdigit() else t
-                    for t in _re.split(r"(\d+)", s)]
-
-        names = list(f.keys())
-        if sorted(names) != sorted(names, key=natural):
-            raise ValueError(
-                "HDF5 group names are not ordering-safe (numeric suffixes "
-                "sort differently alphabetically vs naturally); use the "
-                "full-model modelimport.keras path instead")
+        # ordering safety is checked recursively at every group level
+        # inside _collect_weight_pairs (nested numeric-suffixed names are
+        # just as unsafe as top-level ones)
         pairs = _collect_weight_pairs(f)
     new_params = list(net.params)
     idx = 0

@@ -202,7 +202,7 @@ def csv_read_native(path: str, skip_rows: int = 0) -> np.ndarray:
             np.float32, copy=True).reshape(rows.value, cols.value) \
             if n else np.empty((rows.value, cols.value), np.float32)
     finally:
-        if n:
+        if buf:  # free even for 0-element results (malloc(0) may be non-NULL)
             l.csv_free(buf)
     return out
 

@@ -392,7 +392,7 @@ class ComputationGraph:
         inputs = dict(zip(self.conf.network_inputs, xs))
         batch = int(xs[0].shape[0])
         carries = getattr(self, "_rnn_carries", None)
-        if carries is not None:
+        if carries:  # non-empty: a graph with no recurrent vertices caches {}
             cached_batch = jax.tree_util.tree_leaves(carries)[0].shape[0]
             if cached_batch != batch:
                 raise ValueError(

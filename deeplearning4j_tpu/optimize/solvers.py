@@ -209,20 +209,24 @@ class GraphLineSearchSolver(LineSearchSolver):
 
     @functools.cached_property
     def _vag(self):
+        sign = self._sign
+
         def vag(params, state, inputs, labels, rng, fmasks, lmasks):
             (f, new_state), g = jax.value_and_grad(
                 self.model._loss_fn, has_aux=True)(
                     params, state, inputs, labels, rng, fmasks=fmasks,
                     lmasks=lmasks)
-            return f, new_state, g
+            return sign * f, new_state, _scale(sign, g)
         return jax.jit(vag)
 
     @functools.cached_property
     def _loss_at(self):
+        sign = self._sign
+
         def loss_at(alpha, params, d, state, inputs, labels, rng, fmasks,
                     lmasks):
             p = _axpy(alpha, d, params)
             f, _ = self.model._loss_fn(p, state, inputs, labels, rng,
                                        fmasks=fmasks, lmasks=lmasks)
-            return f
+            return sign * f
         return jax.jit(loss_at)

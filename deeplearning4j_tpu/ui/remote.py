@@ -38,6 +38,7 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         self.timeout = timeout
         self._retry: Deque[Dict] = deque(maxlen=retry_queue_size)
         self._lock = threading.Lock()
+        self._drain_lock = threading.Lock()  # one drainer at a time
 
     def _post(self, payload: Dict) -> bool:
         req = urllib.request.Request(
@@ -59,16 +60,19 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         # (pending+1) timeouts — the drain stops at the first failure.
         with self._lock:
             self._retry.append(payload)
-        while True:
-            with self._lock:
-                if not self._retry:
+        # the drain itself is serialized: without this, two callers could
+        # both read the same head and POST it twice before either pops it
+        with self._drain_lock:
+            while True:
+                with self._lock:
+                    if not self._retry:
+                        return
+                    head = self._retry[0]
+                if not self._post(head):
                     return
-                head = self._retry[0]
-            if not self._post(head):
-                return
-            with self._lock:
-                if self._retry and self._retry[0] is head:
-                    self._retry.popleft()
+                with self._lock:
+                    if self._retry and self._retry[0] is head:
+                        self._retry.popleft()
 
     @property
     def pending(self) -> int:
