@@ -52,16 +52,19 @@ def bench_word2vec(n_sentences=100000, sent_len=20, vocab=10000, epochs=1,
 
 
 def bench_scaling(devices=8):
-    """Weak-scaling efficiency on the virtual CPU mesh, in a subprocess so the
-    parent's TPU-initialized jax doesn't pin the platform."""
+    """Strong-scaling efficiency of the DECLARED config (VGG16, fixed global
+    batch) on the virtual CPU mesh, in a subprocess so the parent's
+    TPU-initialized jax doesn't pin the platform. CPU-feasible sizes
+    (image 32, batch 32); the full phase + updater-ablation run is recorded
+    in BASELINE.md row 5."""
     from deeplearning4j_tpu.util.platform import (
         child_env_with_virtual_devices)
 
     env = child_env_with_virtual_devices(devices)
     out = subprocess.run(
         [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
-         "--devices", str(devices), "--global-batch", "1024",
-         "--steps", "10"],
+         "--devices", str(devices), "--model", "vgg16",
+         "--global-batch", "32", "--steps", "2", "--no-ablation"],
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
         capture_output=True, text=True, timeout=900)
     if out.returncode != 0:

@@ -15,10 +15,12 @@ the (m, l, acc) VMEM scratch carries across kv steps of one q block
 (TPU grids are sequential). Causal masking and ragged (non-multiple)
 sequence lengths are handled with index masks.
 
-Backward pass: the kernel is wrapped in `jax.custom_vjp`; the backward
-recomputes attention with the plain-jnp reference (rematerialization —
-O(T*S) transient inside XLA, which is the standard memory/compute trade
-at this tier; the ring layer keeps the global memory O(T/devices)).
+Backward pass: blockwise Pallas kernels (FlashAttention-2 style). The
+forward additionally emits the per-row logsumexp L = m + log(l); the
+backward recomputes each [bq, bk] probability tile from (q, k, L) in VMEM
+— never materializing the [T, S] matrix in HBM — and accumulates
+  dv += p^T do,   ds = p * (do v^T - D),   dq += ds k,   dk += ds^T q
+with D = rowsum(do * o). Memory stays O(T), matching the forward.
 """
 from __future__ import annotations
 
@@ -57,7 +59,7 @@ def _round_up(n: int, m: int) -> int:
 
 def _make_kernel(causal: bool, sm_scale: float, bq: int, bk: int,
                  s_len: int):
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
         i = pl.program_id(1)
         j = pl.program_id(2)
 
@@ -106,6 +108,8 @@ def _make_kernel(causal: bool, sm_scale: float, bq: int, bk: int,
             o_ref[0] = (acc_ref[:]
                         / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
                             o_ref.dtype)
+            m_safe = jnp.where(jnp.isneginf(m_ref[:]), 0.0, m_ref[:])
+            lse_ref[0] = m_safe + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
     return kernel
 
@@ -121,9 +125,10 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     vp = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0)))
     grid = (B, Tp // bq, Sp // bk)
     kernel = _make_kernel(causal, sm_scale, bq, bk, S)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, Tp, D), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((B, Tp, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, Tp, 128), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
@@ -133,8 +138,10 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM)),
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # running max m
             pltpu.VMEM((bq, 128), jnp.float32),   # running denom l
@@ -142,27 +149,175 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :T]
+    # keep only one lane of the lane-replicated LSE: the residual held from
+    # forward to backward is [B, Tp], not [B, Tp, 128]
+    return out[:, :T], lse[:, :, 0]
+
+
+def _bwd_masks(causal, bq, bk, i, j, t_len, s_len):
+    """[bq, bk] validity mask for tile (i, j): ragged tails + causal."""
+    q_idx = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kv_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = (q_idx < t_len) & (kv_idx < s_len)
+    if causal:
+        mask = mask & (kv_idx <= q_idx)
+    return mask
+
+
+def _make_dq_kernel(causal, sm_scale, bq, bk, t_len, s_len):
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+               dq_ref, acc_ref):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        live = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+
+        @pl.when(live)
+        def _():
+            q_blk = q_ref[0]
+            k_blk = k_ref[0]
+            v_blk = v_ref[0]
+            do_blk = do_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            mask = _bwd_masks(causal, bq, bk, i, j, t_len, s_len)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dsum_ref[0][:, :1]) * sm_scale
+            acc_ref[:] += jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == pl.num_programs(2) - 1)
+        def _():
+            dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(causal, sm_scale, bq, bk, t_len, s_len):
+    """Grid (B, kv_blocks, q_blocks) — q axis innermost so the dk/dv VMEM
+    accumulators carry across q steps of one kv block."""
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+               dk_ref, dv_ref, dk_acc, dv_acc):
+        j = pl.program_id(1)   # kv block
+        i = pl.program_id(2)   # q block (inner)
+
+        @pl.when(i == 0)
+        def _():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        live = (i * bq + bq - 1 >= j * bk) if causal else (i >= 0)
+
+        @pl.when(live)
+        def _():
+            q_blk = q_ref[0]
+            k_blk = k_ref[0]
+            v_blk = v_ref[0]
+            do_blk = do_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            mask = _bwd_masks(causal, bq, bk, i, j, t_len, s_len)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+            dv_acc[:] += jax.lax.dot_general(
+                p, do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dsum_ref[0][:, :1]) * sm_scale
+            dk_acc[:] += jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(2) - 1)
+        def _():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, sm_scale, block_q, block_k,
+                    interpret):
+    B, T, D = q.shape
+    S = k.shape[1]
+    bq = min(block_q, _round_up(T, 8))
+    bk = min(block_k, _round_up(S, 8))
+    Tp, Sp = _round_up(T, bq), _round_up(S, bk)
+    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0)))
+    gp = jnp.pad(g, ((0, 0), (0, Tp - T), (0, 0)))
+    # lane-replicate the [B, Tp] row statistics at kernel-call time
+    lse = jnp.broadcast_to(lse[:, :, None], (B, lse.shape[1], 128))
+    dsum = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dsum = jnp.pad(dsum, ((0, 0), (0, Tp - T)))
+    dsum = jnp.broadcast_to(dsum[:, :, None], (B, Tp, 128))
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        _make_dq_kernel(causal, sm_scale, bq, bk, T, S),
+        out_shape=jax.ShapeDtypeStruct((B, Tp, D), q.dtype),
+        grid=(B, Tp // bq, Sp // bk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, dsum)
+
+    # kv-major grid: swap the roles of the index maps
+    q_spec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0),
+                            memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(causal, sm_scale, bq, bk, T, S),
+        out_shape=(jax.ShapeDtypeStruct((B, Sp, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Sp, D), v.dtype)),
+        grid=(B, Sp // bk, Tp // bq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, dsum)
+    return dq[:, :T], dk[:, :S], dv[:, :S]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                           interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                             interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, sm_scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, sm_scale, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

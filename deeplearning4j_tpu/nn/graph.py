@@ -355,6 +355,73 @@ class ComputationGraph:
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
 
+    def fit_scan_arrays(self, xs, ys, epochs: int = 1):
+        """Device-resident multi-step training: the whole [T]-step pass runs
+        as ONE `lax.scan` dispatch (MultiLayerNetwork.fit_scan_arrays
+        analog for graphs). `xs`: [T, batch, ...] array (single-input
+        graphs) or dict {input_name: [T, batch, ...]}; `ys` likewise for
+        outputs. Pass device-resident arrays (jax.device_put once) — on
+        remote-tunnel backends the link, not the math, is the bottleneck."""
+        from .conf import OptimizationAlgorithm as OA
+
+        if self.params is None:
+            self.init()
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                "fit_scan_arrays supports SGD-updater training only; "
+                "line-search optimizers are per-batch sequential — use fit()")
+        if not isinstance(xs, dict):
+            xs = {self.conf.network_inputs[0]: xs}
+        if not isinstance(ys, dict):
+            ys = {self.conf.network_outputs[0]: ys}
+        xs = {k: jnp.asarray(v) for k, v in xs.items()}
+        ys = {k: jnp.asarray(v) for k, v in ys.items()}
+        key = (tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in xs.items())),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in ys.items())))
+        cache = self.__dict__.setdefault("_scan_epoch_cache", {})
+        epoch_fn = cache.get(key)
+        if epoch_fn is None:
+            step_fn = self.train_step_fn
+
+            @jax.jit
+            def epoch_fn(params, state, opt, step0, xs, ys, rng):
+                n = next(iter(xs.values())).shape[0]
+                keys = jax.random.split(rng, n)
+
+                def body(carry, inp):
+                    params, state, opt, step = carry
+                    xt, yt, k = inp
+                    params, state, opt, score = step_fn(
+                        params, state, opt, step, xt, yt, k, None, None)
+                    return (params, state, opt, step + 1), score
+
+                (params, state, opt, _), scores = jax.lax.scan(
+                    body, (params, state, opt, step0), (xs, ys, keys))
+                return params, state, opt, scores
+
+            cache[key] = epoch_fn
+        n_steps = int(next(iter(xs.values())).shape[0])
+        for _ in range(epochs):
+            self._rng, k = jax.random.split(self._rng)
+            (self.params, self.state, self.updater_state, scores) = epoch_fn(
+                self.params, self.state, self.updater_state,
+                jnp.asarray(self.iteration_count, jnp.int32), xs, ys, k)
+            self.last_batch_size = int(next(iter(xs.values())).shape[1])
+            if self.listeners:
+                host_scores = np.asarray(scores)
+                for i in range(n_steps):
+                    self._score = host_scores[i]
+                    self.iteration_count += 1
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self.iteration_count)
+            else:
+                self._score = scores[-1]
+                self.iteration_count += n_steps
+            self.epoch_count += 1
+        return self
+
     def output(self, *features, features_masks=None):
         if self.params is None:
             self.init()

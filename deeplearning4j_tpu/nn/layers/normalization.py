@@ -3,10 +3,18 @@
 Reference parity:
   * BatchNormalization — `nn/conf/layers/BatchNormalization.java` +
     `nn/layers/normalization/BatchNormalization.java:38` and the cuDNN helper
-    `CudnnBatchNormalizationHelper.java`. TPU-native: plain jnp moment math —
-    XLA fuses normalize+scale+shift into neighbors (the role of the fused
-    cuDNN kernel). Running mean/var live in layer *state* (the reference
-    stores them as non-updated params).
+    `CudnnBatchNormalizationHelper.java`. The layer probes an accelerated
+    helper chain at apply time, exactly like the reference's
+    `BatchNormalization.initializeHelper` probes for the cuDNN impl:
+      1. Pallas fused BN+ReLU kernel (`kernels/bn_relu.py`) for [N, C]
+         batches that fit VMEM (the FF/MLP case);
+      2. the XLA-epilogue fused formulation (`kernels/batchnorm.py`) for
+         sub-f32 training on any shape: one-pass stats fused into the
+         producing conv, custom_vjp backward with ReLU-mask recompute;
+      3. plain two-pass jnp math (numerically exact, Sterbenz-safe) — the
+         fallback, and always the path for f32/f64 (gradient checks).
+    Running mean/var live in layer *state* (the reference stores them as
+    non-updated params).
   * LocalResponseNormalization — `nn/conf/layers/LocalResponseNormalization.java`
     + `nn/layers/normalization/LocalResponseNormalization.java` and
     `CudnnLocalResponseNormalizationHelper.java`. Cross-channel as in the
@@ -69,7 +77,45 @@ class BatchNormalization(LayerConf):
         return {"mean": jnp.zeros((nf,), jnp.float32),
                 "var": jnp.ones((nf,), jnp.float32)}
 
+    def _helper(self, x, train):
+        """Select the accelerated implementation, cuDNN-helper style.
+        Returns 'pallas' | 'fused' | None (plain path)."""
+        act = self.activation or "identity"
+        if not train or act not in ("identity", "relu"):
+            return None
+        if jnp.dtype(x.dtype).itemsize >= 4:
+            return None  # f32/f64: keep the exact two-pass path (gradchecks)
+        if x.ndim == 2 and act == "relu" and not self.lock_gamma_beta:
+            from ...kernels.bn_relu import _block_c
+            if _block_c(x.shape[1], x.shape[0]) is not None:
+                return "pallas"
+        return "fused"
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        helper = self._helper(x, train)
+        if helper is not None:
+            nf = x.shape[-1]
+            if self.lock_gamma_beta:
+                gamma = jnp.full((nf,), self.gamma_init, jnp.float32)
+                beta = jnp.full((nf,), self.beta_init, jnp.float32)
+            else:
+                gamma = params["gamma"].astype(jnp.float32)
+                beta = params["beta"].astype(jnp.float32)
+            if helper == "pallas":
+                from ...kernels.bn_relu import fused_bn_relu
+                y, mean, var = fused_bn_relu(x, gamma, beta, eps=self.eps)
+            else:
+                from ...kernels.batchnorm import fused_bn_act
+                y, mean, var = fused_bn_act(x, gamma, beta, float(self.eps),
+                                            self.activation or "identity")
+            d = self.decay
+            new_state = {
+                "mean": d * state["mean"] + (1 - d) * lax.stop_gradient(mean),
+                "var": d * state["var"] + (1 - d) * lax.stop_gradient(var)}
+            return y, new_state  # activation already fused
+        return self._apply_plain(params, state, x, train=train)
+
+    def _apply_plain(self, params, state, x, *, train=False):
         axes = tuple(range(x.ndim - 1))  # all but feature/channel axis
         # Statistics accumulate in >= f32 (bf16 sums over batch*spatial lose
         # precision and running averages drift; f64 inputs keep f64 so the

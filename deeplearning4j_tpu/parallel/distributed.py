@@ -65,6 +65,18 @@ def global_mesh(model_parallel: int = 1, seq_parallel: int = 1,
     return make_mesh(axes)
 
 
+def global_batch_array(mesh, local, axis: str = MeshAxes.DATA):
+    """Assemble the global, data-axis-sharded jax.Array from THIS process's
+    local batch shard — the dataset plane of multi-host training (each host
+    feeds only its slice; the reference's Spark TrainingMaster fed executors
+    the same way via RDD partitions)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(axis))
+    return jax.make_array_from_process_local_data(sh, np.asarray(local))
+
+
 def local_batch_slice(global_batch: int) -> slice:
     """This process's slice of a globally-sharded batch (dataset plane: each
     host feeds only its own shard — the reference's Spark exporters did the

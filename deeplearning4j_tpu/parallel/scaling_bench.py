@@ -4,16 +4,23 @@ The capability analog of the reference's ParallelWrapper / Spark scaling
 story, measured the way its stats pipeline measures phases
 (`dl4j-spark/.../impl/paramavg/stats/ParameterAveragingTrainingMasterStats.java`):
 per-step wall time at fixed GLOBAL batch, 1 device vs N devices (strong
-scaling). On a real pod over ICI the ideal is t_n = t_1/N. On the virtual CPU
-mesh (`--xla_force_host_platform_device_count`) all "devices" share the same
-host cores, so total compute per step is constant and the ideal is t_n = t_1;
-efficiency = t_1/t_n then isolates framework + collective overhead (the thing
-the virtual mesh *can* measure — ICI bandwidth needs real chips).
+scaling), with per-phase attribution from `TrainingStats` (data/step) and an
+updater ablation (Adam vs plain SGD) that MEASURES how much of the loss is
+replicated-updater work — on the virtual CPU mesh every "device" shares the
+same host cores, so optimizer math that is replicated per-device costs N
+times the flops, an artifact real pods don't have.
+
+On a real pod over ICI the ideal is t_n = t_1/N. On the virtual CPU mesh
+(`--xla_force_host_platform_device_count`) total compute per step is constant
+and the ideal is t_n = t_1; efficiency = t_1/t_n then isolates framework +
+collective overhead (the thing the virtual mesh *can* measure — ICI
+bandwidth needs real chips).
 
 Run standalone:
-    python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8
-Prints one JSON line: {"t1_ms": ..., "tn_ms": ..., "devices": N,
-"efficiency": t1/tn}.
+    python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --model vgg16 --global-batch 64 --steps 4
+Prints one JSON line with t1/tn, phases, efficiency, and the updater
+ablation.
 """
 from __future__ import annotations
 
@@ -43,61 +50,99 @@ def _provision(n_devices: int) -> None:
             "device_count before jax imports or run in a fresh process")
 
 
-def measure(n_devices: int, global_batch: int = 1024, steps: int = 20,
-            warmup: int = 3, hidden: int = 512):
-    """Avg step time (ms) for SYNC data-parallel training of an MLP with a
-    fixed `global_batch` sharded over an n-device mesh."""
-    import jax
-    import numpy as np
-
-    from ..datasets.iterators import DataSet
+def _build_model(model: str, updater: str, image: int, hidden: int):
     from ..nn.conf import InputType, NeuralNetConfiguration
     from ..nn.layers import DenseLayer, OutputLayer
     from ..nn.multilayer import MultiLayerNetwork
-    from ..nn.updaters import Adam
-    from .mesh import make_mesh
-    from .trainer import ParallelTrainer, TrainingMode
+    from ..nn.updaters import Adam, Sgd
 
+    upd = Adam(1e-3) if updater == "adam" else Sgd(1e-2)
+    if model == "vgg16":
+        from ..models.zoo import vgg16
+
+        return vgg16(n_classes=10, image=image, updater=upd).init()
     conf = (NeuralNetConfiguration.builder()
-            .seed(7).updater(Adam(1e-3))
+            .seed(7).updater(upd)
             .list()
             .layer(DenseLayer(n_out=hidden, activation="relu"))
             .layer(DenseLayer(n_out=hidden, activation="relu"))
             .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
             .set_input_type(InputType.feed_forward(784))
             .build())
-    model = MultiLayerNetwork(conf).init()
+    return MultiLayerNetwork(conf).init()
+
+
+def measure(n_devices: int, global_batch: int = 64, steps: int = 4,
+            warmup: int = 2, hidden: int = 512, model: str = "vgg16",
+            updater: str = "adam", image: int = 32):
+    """(ms/step, phases_ms) for SYNC data-parallel training at fixed
+    `global_batch` sharded over an n-device mesh. Phases measured by the
+    trainer's TrainingStats (honest per-phase sync, SparkTrainingStats
+    style)."""
+    import jax
+    import numpy as np
+
+    from ..datasets.iterators import DataSet
+    from .mesh import make_mesh
+    from .trainer import ParallelTrainer, TrainingMode
+
+    net = _build_model(model, updater, image, hidden)
     mesh = make_mesh({"data": n_devices},
                      devices=jax.devices()[:n_devices])
-    trainer = ParallelTrainer(model, mesh=mesh, mode=TrainingMode.SYNC)
-    batch = global_batch
+    trainer = ParallelTrainer(net, mesh=mesh, mode=TrainingMode.SYNC,
+                              collect_stats=True)
     r = np.random.default_rng(0)
-    x = r.normal(size=(batch, 784)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
+    if model == "vgg16":
+        x = r.normal(size=(global_batch, image, image, 3)).astype(np.float32)
+    else:
+        x = r.normal(size=(global_batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, global_batch)]
     ds = DataSet(x, y)
     for _ in range(warmup):
         trainer.fit(ds)
     float(trainer.score())  # host materialization: real sync barrier
+    trainer.stats.reset()
     t0 = time.perf_counter()
     for _ in range(steps):
         trainer.fit(ds)
     float(trainer.score())
     dt = (time.perf_counter() - t0) / steps
-    return dt * 1000.0
+    phases = {k: round(v * 1000.0 / steps, 2)
+              for k, v in trainer.stats.totals().items()}
+    return dt * 1000.0, phases
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
-    ap.add_argument("--global-batch", type=int, default=1024)
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--global-batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--model", choices=("vgg16", "mlp"), default="vgg16")
+    ap.add_argument("--image", type=int, default=32)
+    ap.add_argument("--no-ablation", action="store_true")
     a = ap.parse_args(argv)
     _provision(a.devices)
-    t1 = measure(1, a.global_batch, a.steps)
-    tn = measure(a.devices, a.global_batch, a.steps)
-    print(json.dumps({"t1_ms": round(t1, 2), "tn_ms": round(tn, 2),
-                      "devices": a.devices,
-                      "efficiency": round(t1 / tn, 3)}))
+    t1, ph1 = measure(1, a.global_batch, a.steps, model=a.model,
+                      image=a.image)
+    tn, phn = measure(a.devices, a.global_batch, a.steps, model=a.model,
+                      image=a.image)
+    out = {"model": a.model, "t1_ms": round(t1, 2), "tn_ms": round(tn, 2),
+           "devices": a.devices, "efficiency": round(t1 / tn, 3),
+           "phases_1dev_ms": ph1, "phases_ndev_ms": phn}
+    if not a.no_ablation:
+        # replicated-updater artifact: on the virtual mesh the optimizer
+        # update runs once per device on shared cores. Adam-vs-SGD step
+        # delta at n devices minus the same delta at 1 device == measured
+        # cost of the replication.
+        t1s, _ = measure(1, a.global_batch, a.steps, model=a.model,
+                         image=a.image, updater="sgd")
+        tns, _ = measure(a.devices, a.global_batch, a.steps, model=a.model,
+                         image=a.image, updater="sgd")
+        out["updater_ablation"] = {
+            "t1_sgd_ms": round(t1s, 2), "tn_sgd_ms": round(tns, 2),
+            "efficiency_sgd": round(t1s / tns, 3),
+            "replicated_updater_cost_ms": round((tn - tns) - (t1 - t1s), 2)}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,18 @@ class TrainingStats:
         self._events.append(
             _Event(key, time.time() - self._t0, float(duration_ms)))
 
+    def reset(self):
+        """Drop recorded events (fresh measurement window)."""
+        self._events = []
+        self._t0 = time.time()
+
+    def totals(self) -> Dict[str, float]:
+        """{phase: total seconds} over the recorded window."""
+        out: Dict[str, float] = {}
+        for e in self._events:
+            out[e.key] = out.get(e.key, 0.0) + e.duration_ms / 1e3
+        return out
+
     # -- SparkTrainingStats surface --------------------------------------
     def get_keys(self) -> List[str]:
         seen = []

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import MeshAxes, make_mesh
 from .sharding import ShardingStrategy, param_specs
-from ..datasets.iterators import DataSet, DataSetIterator
+from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
 
 __all__ = ["ParallelTrainer", "ParallelWrapper", "TrainingMode"]
 
@@ -118,7 +118,7 @@ class ParallelTrainer:
             self._step_fn = jax.jit(
                 m.train_step_fn,
                 in_shardings=(p_sh, repl, o_sh, repl, batch_sh, batch_sh,
-                              repl, None, None),
+                              repl, batch_sh, batch_sh),
                 out_shardings=(p_sh, repl, o_sh, repl),
                 donate_argnums=(0, 1, 2))
         else:
@@ -140,21 +140,25 @@ class ParallelTrainer:
             from jax import shard_map
             axis = self.data_axis
 
-            def local_step(params, state, opt, step, x, y, rng):
-                # leading axis is the local replica block (size 1)
+            def local_step(params, state, opt, step, x, y, fm, lm, rng):
+                # leading axis is the local replica block (size 1); x/y are
+                # arrays (MultiLayerNetwork) or dicts (ComputationGraph
+                # MultiDataSet batches) — tree ops cover both; fm/lm are
+                # optional masks (None = empty pytree, passes through)
                 sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
                 uq = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
                 dev = jax.lax.axis_index(axis)
                 rng = jax.random.fold_in(rng, dev)
                 p, s, o, score = self.model.train_step_fn(
-                    sq(params), sq(state), sq(opt), step, x[0], y[0], rng,
-                    None, None)
+                    sq(params), sq(state), sq(opt), step, sq(x), sq(y), rng,
+                    sq(fm), sq(lm))
                 return uq(p), uq(s), uq(o), score[None]
 
             spec = P(axis)
             self._local_step = jax.jit(shard_map(
                 local_step, mesh=mesh,
-                in_specs=(spec, spec, spec, P(), spec, spec, P()),
+                in_specs=(spec, spec, spec, P(), spec, spec, spec, spec,
+                          P()),
                 out_specs=(spec, spec, spec, spec),
                 check_vma=False), donate_argnums=(0, 1, 2))
 
@@ -188,7 +192,7 @@ class ParallelTrainer:
             self.iteration_count = self._pipe.iteration_count
             self._pipe.sync_back()
             return self
-        if isinstance(data, DataSet):
+        if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
         else:
             for _ in range(epochs):
@@ -198,41 +202,79 @@ class ParallelTrainer:
         self._sync_back()
         return self
 
+    def _to_batch(self, ds):
+        """(inputs, labels, fmasks, lmasks) pytrees: arrays for
+        MultiLayerNetwork, dicts for ComputationGraph (which takes DataSet
+        or MultiDataSet — the SparkComputationGraph / ParallelWrapper 'any
+        Model' parity). Masks thread through to the train step exactly as
+        in single-device fit (dp==single parity holds for masked data)."""
+        from ..nn.graph import ComputationGraph
+
+        def none_free(d):
+            # drop None-valued entries: None leaves are empty pytrees, and
+            # an all-None dict just becomes {} (same as no masks)
+            if not isinstance(d, dict):
+                return d
+            out = {k: v for k, v in d.items() if v is not None}
+            return out or None
+
+        if isinstance(self.model, ComputationGraph):
+            inputs, labels, fmasks, lmasks = self.model._to_inputs(ds)
+            return inputs, labels, none_free(fmasks), none_free(lmasks)
+        fm = ds.features_mask
+        lm = ds.labels_mask
+        return (jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                None if fm is None else jnp.asarray(fm),
+                None if lm is None else jnp.asarray(lm))
+
     def _fit_batch(self, ds: DataSet):
         import contextlib
 
+        tmap = jax.tree_util.tree_map
         phase = (self.stats.time if self.stats is not None
                  else (lambda key: contextlib.nullcontext()))
         with phase("data"):
-            x = np.asarray(ds.features)
-            y = np.asarray(ds.labels)
+            xd, yd, fm, lm = self._to_batch(ds)
             n = self.n_data
-            if x.shape[0] % n:
+            bs = jax.tree_util.tree_leaves(xd)[0].shape[0]
+            if bs % n:
                 # pad the global batch to a multiple of the data axis (the
                 # reference round-robins leftovers; padding + weight-0 would
                 # alter loss scale — we simply drop the remainder)
-                keep = (x.shape[0] // n) * n
+                keep = (bs // n) * n
                 if keep == 0:
                     return
-                x, y = x[:keep], y[:keep]
-            xd, yd = jnp.asarray(x), jnp.asarray(y)
+                trim = lambda t: tmap(lambda a: a[:keep], t)
+                xd, yd, fm, lm = trim(xd), trim(yd), trim(fm), trim(lm)
+            if jax.process_count() > 1 and self.mode == TrainingMode.SYNC:
+                # multi-host dataset plane: each process holds the GLOBAL
+                # batch definition but contributes only its slice; assemble
+                # the sharded global array (SPMD over DCN+ICI)
+                from .distributed import global_batch_array, local_batch_slice
+                bs2 = jax.tree_util.tree_leaves(xd)[0].shape[0]
+                sl = local_batch_slice(bs2)
+                mk = lambda t: tmap(lambda a: global_batch_array(
+                    self.mesh, np.asarray(a)[sl], self.data_axis), t)
+                xd, yd, fm, lm = mk(xd), mk(yd), mk(fm), mk(lm)
         self._rng, rng = jax.random.split(self._rng)
         step = jnp.asarray(self.iteration_count, jnp.int32)
         if self.mode == TrainingMode.SYNC:
             with phase("step"):
                 self._params, self._state, self._opt, score = self._step_fn(
                     self._params, self._state, self._opt, step,
-                    xd, yd, rng, None, None)
+                    xd, yd, rng, fm, lm)
                 self._score = score
                 if self.stats is not None:
                     float(jnp.asarray(score))  # sync for honest timing
         else:
             with phase("step"):
-                xs = xd.reshape(n, -1, *x.shape[1:])
-                ys = yd.reshape(n, -1, *y.shape[1:])
+                resh = lambda t: tmap(
+                    lambda a: a.reshape(n, -1, *a.shape[1:]), t)
+                xs, ys, fms, lms = resh(xd), resh(yd), resh(fm), resh(lm)
                 (self._params, self._state, self._opt,
                  scores) = self._local_step(
-                    self._params, self._state, self._opt, step, xs, ys, rng)
+                    self._params, self._state, self._opt, step, xs, ys,
+                    fms, lms, rng)
                 self._score = scores.mean()
                 if self.stats is not None:
                     float(jnp.asarray(self._score))

@@ -1,0 +1,56 @@
+"""Child process for the real 2-process distributed test.
+
+Usage: python _dist_child.py <coordinator> <num_procs> <process_id> <outdir>
+
+Each process owns 4 virtual CPU devices (XLA_FLAGS set by the parent);
+together they form one 8-device global mesh. Trains the same model on the
+same deterministic global batch as the single-process reference and writes
+its view of the final parameters.
+"""
+import sys
+
+import numpy as np
+
+
+def main():
+    coord, n_procs, pid, outdir = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n_procs, process_id=pid)
+    assert jax.process_count() == n_procs
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from deeplearning4j_tpu import (DataSet, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer, Sgd)
+    from deeplearning4j_tpu.parallel import (ParallelTrainer, TrainingMode,
+                                             make_mesh)
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 64)]
+
+    mesh = make_mesh({"data": 8})   # spans both processes (4 local each)
+    trainer = ParallelTrainer(model, mesh=mesh, mode=TrainingMode.SYNC)
+    ds = DataSet(x, y)
+    for _ in range(5):
+        trainer.fit(ds)
+    # replicated params are fully addressable on every host
+    flat = np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree_util.tree_leaves(model.params)])
+    np.save(f"{outdir}/params_p{pid}.npy", flat)
+    print(f"proc {pid} done score={trainer.score():.6f}")
+
+
+if __name__ == "__main__":
+    main()

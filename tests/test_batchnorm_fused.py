@@ -1,0 +1,143 @@
+"""Fused BatchNorm helper-tier tests.
+
+The reference validates its cuDNN BN helper against the built-in impl
+(`CuDNNGradientChecks.java`, `BatchNormalizationTest`): here the fused
+XLA-epilogue formulation (`kernels/batchnorm.py`) and the layer's helper
+probing (`nn/layers/normalization.py`) are validated against the exact
+two-pass path the same way.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.kernels.batchnorm import fused_bn_act
+from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+
+def _ref_bn(x, gamma, beta, eps, act):
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), mean, var
+
+
+@pytest.mark.parametrize("shape", [(64, 16), (8, 6, 6, 24)])
+@pytest.mark.parametrize("act", ["identity", "relu"])
+def test_fused_bn_act_forward_matches_oracle(shape, act):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(2.0, 1.5, shape).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.1, shape[-1]).astype(np.float32))
+    beta = jnp.asarray(rng.normal(0.0, 0.1, shape[-1]).astype(np.float32))
+    y, mean, var = fused_bn_act(x, gamma, beta, 1e-5, act)
+    yr, mr, vr = _ref_bn(x, gamma, beta, 1e-5, act)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mr), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(vr), rtol=1e-3,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(32, 12), (6, 5, 5, 16)])
+@pytest.mark.parametrize("act", ["identity", "relu"])
+def test_fused_bn_act_backward_matches_autodiff(shape, act):
+    """custom_vjp dx/dgamma/dbeta vs jax.grad of the reference math (the
+    stats are stop-gradient in both: the fused vjp ignores their
+    cotangents, so compare gradients of sum(y) only)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0.5, 1.0, shape).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.1, shape[-1]).astype(np.float32))
+    beta = jnp.asarray(rng.normal(0.0, 0.1, shape[-1]).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+    def f_fused(x, g, b):
+        y, _, _ = fused_bn_act(x, g, b, 1e-5, act)
+        return jnp.sum(y * w)
+
+    def f_ref(x, g, b):
+        y, _, _ = _ref_bn(x, g, b, 1e-5, act)
+        return jnp.sum(y * w)
+
+    gf = jax.grad(f_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_layer_helper_selection():
+    bn = BatchNormalization(n_out=16, activation="relu")
+    x_f32 = jnp.zeros((8, 4, 4, 16), jnp.float32)
+    x_bf16 = jnp.zeros((8, 4, 4, 16), jnp.bfloat16)
+    x2d_bf16 = jnp.zeros((64, 16), jnp.bfloat16)
+    assert bn._helper(x_f32, train=True) is None      # exact path for f32
+    assert bn._helper(x_bf16, train=False) is None    # inference: plain
+    assert bn._helper(x_bf16, train=True) == "fused"  # conv bf16 train
+    assert bn._helper(x2d_bf16, train=True) == "pallas"  # FF fits VMEM
+    bn_tanh = BatchNormalization(n_out=16, activation="tanh")
+    assert bn_tanh._helper(x_bf16, train=True) is None  # unfusable act
+
+
+@pytest.mark.parametrize("shape", [(32, 10), (8, 5, 5, 12)])
+def test_layer_fused_matches_plain_bf16(shape):
+    """Train-mode layer apply: helper output vs the plain two-pass path on
+    the same bf16 input (the CuDNNBatchNormalizationHelper equivalence
+    check)."""
+    rng = np.random.default_rng(2)
+    bn = BatchNormalization(n_out=shape[-1], activation="relu")
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    it = (InputType.convolutional(shape[1], shape[2], shape[3])
+          if len(shape) == 4 else InputType.feed_forward(shape[-1]))
+    params = bn.init_params(jax.random.PRNGKey(0), it)
+    state = bn.init_state(it)
+    x = jnp.asarray(rng.normal(0.0, 1.0, shape), jnp.bfloat16)
+    y_fast, st_fast = bn.apply(params, state, x, train=True)
+    y_plain, st_plain = bn._apply_plain(params, state, x, train=True)
+    np.testing.assert_allclose(np.asarray(y_fast, np.float32),
+                               np.asarray(y_plain, np.float32),
+                               rtol=0.05, atol=0.05)  # bf16 tolerance
+    for k in st_fast:
+        np.testing.assert_allclose(np.asarray(st_fast[k]),
+                                   np.asarray(st_plain[k]),
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_graph_fit_scan_arrays_matches_fit():
+    """Graph device-resident scan epoch == per-step fit (param equality),
+    the TestCompareParameterAveraging-style equivalence gate."""
+    from deeplearning4j_tpu import (DataSet, NeuralNetConfiguration,
+                                    OutputLayer)
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer
+
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(4, 16, 6)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 16))]
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(7).graph_builder()
+        b.add_inputs("in")
+        b.add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+        b.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "h")
+        b.set_outputs("out")
+        b.set_input_types(IT.feed_forward(6))
+        return ComputationGraph(b.build()).init()
+
+    g1 = build()
+    for i in range(xs.shape[0]):
+        g1.fit(DataSet(xs[i], ys[i]))
+    g2 = build()
+    g2.fit_scan_arrays(xs, ys)
+    assert g2.iteration_count == 4
+    for name in g1.params:
+        for k in g1.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(g1.params[name][k]),
+                np.asarray(g2.params[name][k]), rtol=1e-5, atol=1e-6)

@@ -20,7 +20,8 @@ from deeplearning4j_tpu.datasets.fetchers import (CifarDataFetcher,
 from deeplearning4j_tpu.datasets.impl import (CifarDataSetIterator,
                                               IrisDataSetIterator,
                                               MnistDataSetIterator)
-from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import (ArrayDataSetIterator,
+                                                   AsyncDataSetIterator)
 
 
 def _write_idx_images(path, arr: np.ndarray, gz=True):
@@ -131,22 +132,90 @@ def test_iris_convergence_gate():
     assert acc >= 0.95, acc
 
 
-@pytest.mark.skipif(
-    not os.path.exists(os.path.expanduser(
-        "~/.deeplearning4j_tpu/mnist/train-images-idx3-ubyte.gz")),
-    reason="real MNIST not cached (offline environment)")
 def test_mnist_convergence_gate():
-    """LeNet >= 99% / MLP >= 97% on real MNIST — runs only when the dataset
-    is present in the cache."""
+    """REAL-pixel MNIST convergence (reference MnistDataFetcher.java:40 +
+    the `MNIST >= 97%` example gates). With the full cached dataset: LeNet
+    >= 99% on the 10k test set. Offline (this environment): the in-repo
+    bundled subset of 384 real MNIST digits — LeNet >= 90% on 64 held-out
+    real digits (subset-scaled threshold; calibrated 93.8%)."""
     from deeplearning4j_tpu.models.zoo import lenet_mnist
 
-    train = MnistDataSetIterator(batch_size=256, train=True, shuffle=True,
-                                 seed=1)
-    test = MnistDataSetIterator(batch_size=512, train=False)
     model = lenet_mnist().init()
-    model.fit(train, epochs=3)
-    acc = model.evaluate(test).accuracy()
-    assert acc >= 0.99, acc
+    if os.path.exists(os.path.expanduser(
+            "~/.deeplearning4j_tpu/mnist/train-images-idx3-ubyte.gz")):
+        train = MnistDataSetIterator(batch_size=256, train=True,
+                                     shuffle=True, seed=1)
+        test = MnistDataSetIterator(batch_size=512, train=False)
+        model.fit(train, epochs=3)
+        acc = model.evaluate(test).accuracy()
+        assert acc >= 0.99, acc
+    else:
+        from deeplearning4j_tpu.datasets.fetchers import bundled_mnist_subset
+
+        xtr, ytr, xte, yte = bundled_mnist_subset()
+        for epoch in range(30):
+            model.fit(ArrayDataSetIterator(xtr, ytr, batch_size=64,
+                                           shuffle=True, seed=epoch))
+        acc = model.evaluate(
+            ArrayDataSetIterator(xte, yte, batch_size=64)).accuracy()
+        assert acc >= 0.90, acc
+
+
+def test_cifar_smoke_train_gate():
+    """CIFAR input-pipeline smoke train: the binary record path (reference
+    CifarDataSetIterator.java:17 layout) feeds a conv net end-to-end and
+    the net fits its batches. Uses the real cached dataset when present;
+    offline, format-faithful synthesized batches (real CIFAR pixels are
+    not obtainable without egress — the gate then validates the pipeline +
+    optimization, not generalization)."""
+    from deeplearning4j_tpu import (Adam, ConvolutionLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    SubsamplingLayer)
+    from deeplearning4j_tpu.nn.layers import ConvolutionMode, PoolingType
+
+    cache = os.path.expanduser("~/.deeplearning4j_tpu/cifar10")
+    real = os.path.exists(os.path.join(cache, "data_batch_1.bin"))
+    if real:
+        it = CifarDataSetIterator(batch_size=64)
+    else:
+        r = np.random.default_rng(0)
+        tmp = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                           "cifar_smoke")
+        os.makedirs(tmp, exist_ok=True)
+        n = 256
+        labels = r.integers(0, 10, n).astype(np.uint8)
+        # separable-by-class pixel structure so optimization is checkable
+        pix = (labels[:, None] * 25 + r.integers(0, 25, (n, 3072))
+               ).astype(np.uint8)
+        recs = np.concatenate([labels[:, None], pix], axis=1)
+        for i, chunk in enumerate(np.array_split(recs, 5), start=1):
+            with open(os.path.join(tmp, f"data_batch_{i}.bin"), "wb") as f:
+                f.write(np.ascontiguousarray(chunk).tobytes())
+        it = CifarDataSetIterator(batch_size=64, cache=tmp)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                    stride=(1, 1), activation="relu",
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(32, 32, 3))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    if real:
+        # 50k real images: keep the smoke budget bounded — 1 epoch, gate at
+        # well-above-chance (this tiny 16-filter net reaches ~45-55%)
+        model.fit(it, epochs=1)
+        acc = model.evaluate(it).accuracy()
+        assert acc >= 0.35, acc
+    else:
+        model.fit(it, epochs=50)
+        acc = model.evaluate(it).accuracy()
+        assert acc >= 0.9, acc
 
 
 def test_curves_fetcher_generates_autoencoder_data():
