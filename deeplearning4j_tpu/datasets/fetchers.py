@@ -354,17 +354,19 @@ class LFWDataFetcher:
         return counted
 
     def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
-        from PIL import Image
+        # decode through the image tier: native C++ decoders (PNG/BMP/PPM)
+        # with PIL fallback for JPEG — same path ImageRecordReader uses
+        from .images import ImageLoader
 
         root = self._root()
         counted = self._counted(root)
         xs, ys = [], []
         s = self.image_size
+        loader = ImageLoader(s, s, 3)
         for label, (person, files) in enumerate(counted):
             for f in files:
-                img = Image.open(os.path.join(root, person, f))
-                img = img.convert("RGB").resize((s, s))
-                xs.append(np.asarray(img, np.float32) / 255.0)
+                xs.append(loader.load(os.path.join(root, person, f))
+                          .astype(np.float32))
                 ys.append(label)
         n_cls = len(counted)
         x = np.stack(xs) if xs else np.zeros((0, s, s, 3), np.float32)

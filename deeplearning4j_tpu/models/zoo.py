@@ -78,9 +78,13 @@ def char_rnn(vocab_size: int = 77, lstm_size: int = 200, seq_len: int = 64,
     return MultiLayerNetwork(conf)
 
 
-def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 60,
+def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 240,
                    warmup: int = 3, vocab: int = 77):
-    """tokens/sec for char-RNN training (BASELINE config #3)."""
+    """tokens/sec for char-RNN training (BASELINE config #3). Steps are
+    sized so the one-time dispatch+sync round trip through the remote
+    tunnel (~95 ms measured, an attach-mode artifact, not chip time)
+    amortizes below ~5%: the number reports training throughput, not RPC
+    latency. Device-time cross-check via the profiler: see BASELINE.md."""
     from ..datasets.iterators import DataSet
 
     model = char_rnn(vocab_size=vocab, seq_len=seq_len, tbptt=64).init()
@@ -236,8 +240,10 @@ def vgg16(n_classes: int = 1000, image: int = 224, seed: int = 42,
     return _vgg(cfg, n_classes, image, seed, updater)
 
 
-def bench_lenet(batch: int = 512, steps: int = 200, warmup: int = 5):
-    """samples/sec for LeNet-MNIST training steps (BASELINE config #1)."""
+def bench_lenet(batch: int = 512, steps: int = 800, warmup: int = 5):
+    """samples/sec for LeNet-MNIST training steps (BASELINE config #1).
+    Step count amortizes the fixed ~95 ms tunnel dispatch+sync round trip
+    (attach-mode artifact) below ~5% — see bench_char_rnn note."""
     from ..datasets.iterators import DataSet
 
     model = lenet_mnist().init()

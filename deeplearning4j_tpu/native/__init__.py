@@ -23,7 +23,7 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["native_available", "lib", "idx_read_native", "csv_read_native",
-           "u8_to_f32", "PrefetchRing"]
+           "u8_to_f32", "image_decode_native", "PrefetchRing"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "dl4j_native.cpp")
@@ -46,11 +46,17 @@ def _build(dest: str) -> bool:
     # process with the old .so mmapped keeps its (unlinked) inode instead
     # of taking SIGBUS from an in-place truncate
     tmp = f"{dest}.build.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", tmp]
     try:
-        out = subprocess.run(cmd, capture_output=True, text=True,
+        # zlib is only needed by the PNG decoder: if the dev files are
+        # missing, fall back to a zlib-free build (PNG -> PIL) instead of
+        # losing the whole native tier
+        out = subprocess.run(base + ["-lz"], capture_output=True, text=True,
                              timeout=180)
+        if out.returncode != 0:
+            out = subprocess.run(base + ["-DDL4J_NO_ZLIB"],
+                                 capture_output=True, text=True, timeout=180)
         if out.returncode != 0:
             log.warning("native build failed:\n%s", out.stderr[-2000:])
             return False
@@ -99,6 +105,12 @@ def _bind(lib: ctypes.CDLL):
     lib.ring_close.restype = None
     lib.ring_error.argtypes = [ctypes.c_void_p]
     lib.ring_error.restype = c_int
+    int_p = ctypes.POINTER(c_int)
+    lib.image_decode_alloc.argtypes = [c_char_p, ctypes.POINTER(u8_p),
+                                       int_p, int_p, int_p]
+    lib.image_decode_alloc.restype = c_int
+    lib.image_free.argtypes = [u8_p]
+    lib.image_free.restype = None
     lib.dl4j_native_abi.argtypes = []
     lib.dl4j_native_abi.restype = c_int
 
@@ -121,7 +133,7 @@ def _load() -> Optional[ctypes.CDLL]:
                     return None
             lib = ctypes.CDLL(path)
             _bind(lib)
-            if lib.dl4j_native_abi() != 1:
+            if lib.dl4j_native_abi() != 2:
                 return None
             _LIB = lib
         except Exception as e:   # ANY probe failure degrades to pure Python
@@ -221,6 +233,33 @@ def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0,
         l.u8_binarize_f32(sp, dp, src.size, threshold)
     else:
         l.u8_to_f32(sp, dp, src.size, scale, shift)
+    return out
+
+
+def image_decode_native(path: str) -> Optional[np.ndarray]:
+    """Decode PNG/BMP/PPM/PGM natively -> uint8 [H, W, C] in ONE pass.
+    Returns None for formats the native tier doesn't cover (JPEG etc., or
+    PNG on a zlib-free build) — the caller falls back to PIL. Raises
+    ValueError on corrupt files."""
+    l = lib()
+    w, h, ch = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    rc = l.image_decode_alloc(path.encode(), ctypes.byref(buf),
+                              ctypes.byref(w), ctypes.byref(h),
+                              ctypes.byref(ch))
+    if rc == -2:
+        return None
+    if rc == -1:
+        raise FileNotFoundError(path)
+    if rc != 0:
+        raise ValueError(f"corrupt image file {path!r} (rc={rc})")
+    try:
+        n = h.value * w.value * ch.value
+        out = np.ctypeslib.as_array(buf, shape=(n,)).copy().reshape(
+            h.value, w.value, ch.value)
+    finally:
+        if buf:
+            l.image_free(buf)
     return out
 
 

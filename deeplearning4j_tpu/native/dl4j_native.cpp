@@ -423,10 +423,290 @@ void ring_close(void* handle) {
 
 int ring_error(void* handle) { return ((PrefetchRing*)handle)->error; }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Image decode: PNG (zlib), BMP (24/32bpp uncompressed), PPM/PGM binary.
+// The reference's image tier is DataVec's JavaCV ImageRecordReader
+// (`datavec-data-image` NativeImageLoader); here the common lossless
+// formats decode natively and the Python side falls back to PIL for JPEG.
+// ---------------------------------------------------------------------------
+#ifndef DL4J_NO_ZLIB
+#include <zlib.h>
+#endif
+
+#include <cctype>
+
+namespace {
+
+// sanity caps on untrusted header dimensions: decoders must return -3 on
+// corrupt files, never abort the process on a 30 GB bad_alloc or wrap a
+// size_t bounds check
+constexpr int64_t kMaxDim = 1 << 16;          // 65536 px per side
+constexpr int64_t kMaxPixels = 1LL << 28;     // 256M elements (x channels)
+
+static bool dims_ok(int64_t w, int64_t h, int64_t ch) {
+  return w > 0 && h > 0 && w <= kMaxDim && h <= kMaxDim &&
+         w * h * ch <= kMaxPixels;
+}
+
+struct Bytes {
+  std::vector<unsigned char> v;
+};
+
+static bool read_file(const char* path, std::vector<unsigned char>& out) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (n < 0) { std::fclose(f); return false; }
+  out.resize((size_t)n);
+  size_t got = n ? std::fread(out.data(), 1, (size_t)n, f) : 0;
+  std::fclose(f);
+  return got == (size_t)n;
+}
+
+static uint32_t be32(const unsigned char* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+static int paeth(int a, int b, int c) {
+  int p = a + b - c, pa = std::abs(p - a), pb = std::abs(p - b),
+      pc = std::abs(p - c);
+  if (pa <= pb && pa <= pc) return a;
+  if (pb <= pc) return b;
+  return c;
+}
+
+// Decode an 8-bit non-interlaced PNG. Returns 0 ok, -2 unsupported,
+// -3 corrupt. On ok fills w/h/ch and `pix` (interleaved, palette expanded
+// to RGB).
+#ifdef DL4J_NO_ZLIB
+static int png_decode(const std::vector<unsigned char>& buf, int*, int*,
+                      int*, std::vector<unsigned char>&) {
+  static const unsigned char SIG[8] = {0x89, 'P', 'N', 'G', '\r', '\n',
+                                       0x1A, '\n'};
+  // zlib-free build: PNG is unsupported (PIL fallback), other formats work
+  (void)SIG;
+  return -2;
+}
+#else
+static int png_decode(const std::vector<unsigned char>& buf, int* w, int* h,
+                      int* ch, std::vector<unsigned char>& pix) {
+  static const unsigned char SIG[8] = {0x89, 'P', 'N', 'G', '\r', '\n',
+                                       0x1A, '\n'};
+  if (buf.size() < 8 || std::memcmp(buf.data(), SIG, 8) != 0) return -2;
+  size_t i = 8;
+  uint32_t W = 0, H = 0;
+  int bit_depth = 0, color_type = -1, interlace = 0;
+  std::vector<unsigned char> idat, plte;
+  while (i + 8 <= buf.size()) {
+    uint32_t len = be32(&buf[i]);
+    if (i + 12 + (size_t)len > buf.size()) return -3;
+    const unsigned char* tag = &buf[i + 4];
+    const unsigned char* data = &buf[i + 8];
+    if (!std::memcmp(tag, "IHDR", 4)) {
+      if (len < 13) return -3;
+      W = be32(data);
+      H = be32(data + 4);
+      bit_depth = data[8];
+      color_type = data[9];
+      interlace = data[12];
+    } else if (!std::memcmp(tag, "PLTE", 4)) {
+      plte.assign(data, data + len);
+    } else if (!std::memcmp(tag, "IDAT", 4)) {
+      idat.insert(idat.end(), data, data + len);
+    } else if (!std::memcmp(tag, "IEND", 4)) {
+      break;
+    }
+    i += 12 + len;
+  }
+  if (!W || !H || idat.empty()) return -3;
+  if (bit_depth != 8 || interlace != 0) return -2;  // PIL fallback
+  if (!dims_ok(W, H, 4)) return -3;
+  int nch;
+  switch (color_type) {
+    case 0: nch = 1; break;   // gray
+    case 2: nch = 3; break;   // rgb
+    case 3: nch = 1; break;   // palette index (expanded below)
+    case 4: nch = 2; break;   // gray+alpha
+    case 6: nch = 4; break;   // rgba
+    default: return -2;
+  }
+  size_t stride = (size_t)W * nch;
+  std::vector<unsigned char> raw(H * (stride + 1));
+  uLongf raw_len = (uLongf)raw.size();
+  if (uncompress(raw.data(), &raw_len, idat.data(), (uLong)idat.size())
+          != Z_OK || raw_len != raw.size())
+    return -3;
+  // unfilter
+  std::vector<unsigned char> img(H * stride);
+  for (uint32_t y = 0; y < H; y++) {
+    const unsigned char* row = &raw[y * (stride + 1)];
+    unsigned char filter = row[0];
+    const unsigned char* src = row + 1;
+    unsigned char* dst = &img[y * stride];
+    const unsigned char* up = y ? &img[(y - 1) * stride] : nullptr;
+    for (size_t x = 0; x < stride; x++) {
+      int a = x >= (size_t)nch ? dst[x - nch] : 0;
+      int b = up ? up[x] : 0;
+      int c = (up && x >= (size_t)nch) ? up[x - nch] : 0;
+      int v = src[x];
+      switch (filter) {
+        case 0: break;
+        case 1: v += a; break;
+        case 2: v += b; break;
+        case 3: v += (a + b) / 2; break;
+        case 4: v += paeth(a, b, c); break;
+        default: return -3;
+      }
+      dst[x] = (unsigned char)v;
+    }
+  }
+  if (color_type == 3) {  // expand palette to RGB
+    if (plte.size() < 3) return -3;
+    pix.resize((size_t)W * H * 3);
+    for (size_t p = 0; p < (size_t)W * H; p++) {
+      size_t idx = (size_t)img[p] * 3;
+      if (idx + 2 >= plte.size()) return -3;
+      pix[p * 3] = plte[idx];
+      pix[p * 3 + 1] = plte[idx + 1];
+      pix[p * 3 + 2] = plte[idx + 2];
+    }
+    nch = 3;
+  } else {
+    pix.swap(img);
+  }
+  *w = (int)W;
+  *h = (int)H;
+  *ch = nch;
+  return 0;
+}
+#endif  // DL4J_NO_ZLIB
+
+// Uncompressed 24/32bpp BMP (bottom-up or top-down), BGR(A) -> RGB(A).
+static int bmp_decode(const std::vector<unsigned char>& buf, int* w, int* h,
+                      int* ch, std::vector<unsigned char>& pix) {
+  if (buf.size() < 54 || buf[0] != 'B' || buf[1] != 'M') return -2;
+  auto le32 = [&](size_t o) -> int32_t {
+    return (int32_t)(buf[o] | (buf[o + 1] << 8) | (buf[o + 2] << 16) |
+                     ((uint32_t)buf[o + 3] << 24));
+  };
+  auto le16 = [&](size_t o) -> int {
+    return buf[o] | (buf[o + 1] << 8);
+  };
+  uint32_t off = (uint32_t)le32(10);
+  int32_t W = le32(18), Hs = le32(22);
+  int bpp = le16(28);
+  int32_t compression = le32(30);
+  if (compression != 0 || (bpp != 24 && bpp != 32)) return -2;
+  bool flip = Hs > 0;
+  int32_t H = Hs > 0 ? Hs : -Hs;
+  if (!dims_ok(W, H, 4)) return -3;
+  int sch = bpp / 8;
+  int64_t row_in = (((int64_t)W * sch + 3) / 4) * 4;   // 4-byte aligned
+  if ((int64_t)off + row_in * H > (int64_t)buf.size()) return -3;
+  int nch = sch == 4 ? 4 : 3;
+  pix.resize((size_t)W * H * nch);
+  for (int32_t y = 0; y < H; y++) {
+    const unsigned char* src = &buf[off + (size_t)(flip ? H - 1 - y : y)
+                                             * row_in];
+    unsigned char* dst = &pix[(size_t)y * W * nch];
+    for (int32_t x = 0; x < W; x++) {
+      dst[x * nch] = src[x * sch + 2];       // R <- B position
+      dst[x * nch + 1] = src[x * sch + 1];   // G
+      dst[x * nch + 2] = src[x * sch];       // B <- R position
+      if (nch == 4) dst[x * nch + 3] = src[x * sch + 3];
+    }
+  }
+  *w = W;
+  *h = H;
+  *ch = nch;
+  return 0;
+}
+
+// Binary PPM (P6, RGB) / PGM (P5, gray), maxval <= 255.
+static int pnm_decode(const std::vector<unsigned char>& buf, int* w, int* h,
+                      int* ch, std::vector<unsigned char>& pix) {
+  if (buf.size() < 2 || buf[0] != 'P' || (buf[1] != '5' && buf[1] != '6'))
+    return -2;
+  int nch = buf[1] == '6' ? 3 : 1;
+  size_t i = 2;
+  long vals[3];
+  for (int k = 0; k < 3; k++) {
+    // skip whitespace + comments
+    while (i < buf.size()) {
+      if (std::isspace(buf[i])) { i++; continue; }
+      if (buf[i] == '#') { while (i < buf.size() && buf[i] != '\n') i++; continue; }
+      break;
+    }
+    long v = 0;
+    bool any = false;
+    while (i < buf.size() && std::isdigit(buf[i])) {
+      v = v * 10 + (buf[i] - '0');
+      i++;
+      any = true;
+    }
+    if (!any) return -3;
+    vals[k] = v;
+  }
+  if (i >= buf.size() || !std::isspace(buf[i])) return -3;
+  i++;  // single whitespace after maxval
+  long W = vals[0], H = vals[1], maxv = vals[2];
+  if (maxv <= 0 || maxv > 255) return -2;
+  if (!dims_ok(W, H, 3)) return -3;
+  size_t need = (size_t)W * H * nch;
+  if (buf.size() - i < need) return -3;
+  pix.assign(buf.begin() + i, buf.begin() + i + need);
+  *w = (int)W;
+  *h = (int)H;
+  *ch = nch;
+  return 0;
+}
+
+static int decode_any(const char* path, int* w, int* h, int* ch,
+                      std::vector<unsigned char>& pix) {
+  // corrupt files must produce an error code, never terminate the host
+  // process: guard against bad_alloc/length_error from hostile headers
+  try {
+    std::vector<unsigned char> buf;
+    if (!read_file(path, buf)) return -1;
+    int rc = png_decode(buf, w, h, ch, pix);
+    if (rc != -2) return rc;
+    rc = bmp_decode(buf, w, h, ch, pix);
+    if (rc != -2) return rc;
+    return pnm_decode(buf, w, h, ch, pix);
+  } catch (...) {
+    return -3;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode ONCE into a malloc'd buffer (interleaved u8, row-major) the
+// caller frees with image_free. rc: 0 ok (fills *out/w/h/ch), -1 io
+// error, -2 unsupported format (caller falls back to PIL), -3 corrupt.
+int image_decode_alloc(const char* path, unsigned char** out, int* w,
+                       int* h, int* ch) {
+  std::vector<unsigned char> pix;
+  int rc = decode_any(path, w, h, ch, pix);
+  if (rc != 0) return rc;
+  *out = (unsigned char*)std::malloc(pix.size() ? pix.size() : 1);
+  if (!*out) return -3;
+  std::memcpy(*out, pix.data(), pix.size());
+  return 0;
+}
+
+void image_free(unsigned char* p) { std::free(p); }
+
 // ---------------------------------------------------------------------------
 // Version probe
 // ---------------------------------------------------------------------------
 
-int dl4j_native_abi() { return 1; }
+int dl4j_native_abi() { return 2; }
 
 }  // extern "C"

@@ -21,13 +21,54 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .word2vec import Word2Vec
+from .glove import Glove
+from .word2vec import ParagraphVectors, Word2Vec
 
-__all__ = ["DistributedWord2Vec"]
+__all__ = ["DistributedWord2Vec", "DistributedGlove",
+           "DistributedParagraphVectors"]
 
 
-class DistributedWord2Vec(Word2Vec):
-    """Word2Vec with the SGNS epoch data-parallel over a mesh axis.
+class _MeshMixin:
+    """Shared mesh plumbing for the Distributed* embedding models: batch
+    placement over the data axis + divisibility handling."""
+
+    def _init_mesh(self, mesh: Optional[Mesh], data_axis: str):
+        self.mesh = mesh
+        self.data_axis = data_axis
+
+    def _axis_size(self) -> int:
+        return self.mesh.shape[self.data_axis] if self.mesh is not None else 1
+
+    def _require_divisible(self, B: int) -> int:
+        """User-visible batch sizes must divide the axis: silently rounding
+        them would re-partition the shuffled stream into different steps
+        than the single-device run, breaking the parameter-identical
+        guarantee these classes advertise."""
+        n = self._axis_size()
+        if B % n:
+            raise ValueError(
+                f"batch_size {B} not divisible by the {n}-way "
+                f"'{self.data_axis}' mesh axis; pick a multiple so "
+                "multi-chip steps stay identical to single-device")
+        return B
+
+    def _round_up(self, B: int) -> int:
+        """Internal (derived) batch sizes can be rounded up safely."""
+        n = self._axis_size()
+        return -(-B // n) * n
+
+    def _shard_dim(self, arr, dim: int):
+        if self.mesh is None:
+            return arr
+        spec = [None] * arr.ndim
+        spec[dim] = self.data_axis
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+
+class DistributedWord2Vec(_MeshMixin, Word2Vec):
+    """Word2Vec with both training paths data-parallel over a mesh axis:
+    the SGNS corpus fast path (center positions sharded) AND the generic
+    pair path (cbow / hierarchical-softmax batches sharded).
 
     Same math as single-device Word2Vec (the per-step batch is summed
     across devices by the XLA-inserted psum, exactly like the batched-sum
@@ -38,19 +79,60 @@ class DistributedWord2Vec(Word2Vec):
     def __init__(self, mesh: Optional[Mesh] = None,
                  data_axis: str = "data", **kw):
         super().__init__(**kw)
-        self.mesh = mesh
-        self.data_axis = data_axis
-
-    def _axis_size(self) -> int:
-        return self.mesh.shape[self.data_axis] if self.mesh is not None else 1
+        self._init_mesh(mesh, data_axis)
 
     def _sg_round_batch(self, B: int) -> int:
-        n = self._axis_size()
-        return -(-B // n) * n   # centers-per-step divisible by the axis
+        return self._round_up(B)   # derived centers-per-step: round safely
 
     def _sg_place_positions(self, pos):
-        if self.mesh is None:
-            return pos
-        # [T, B]: shard the batch axis; scan steps stay sequential
-        sh = NamedSharding(self.mesh, P(None, self.data_axis))
-        return jax.device_put(pos, sh)
+        return self._shard_dim(pos, 1)  # [T, B]: shard the batch axis
+
+    def _pair_round_batch(self, B: int) -> int:
+        return self._require_divisible(B)
+
+    def _pair_place(self, arr):
+        return self._shard_dim(arr, 1)  # [T, B, ...]
+
+
+class DistributedParagraphVectors(_MeshMixin, ParagraphVectors):
+    """ParagraphVectors (DBOW/DM) with the pair batches data-parallel over
+    a mesh axis — the `dl4j-spark-nlp-java8/.../SparkParagraphVectors.java`
+    capability, TPU-first: per-step batched-sum gradients are summed
+    across devices by the XLA-inserted psum, so multi-chip training is
+    parameter-identical to single-device (no Spark-style per-split
+    averaging drift; batch_size must divide the axis). Verified in
+    tests/test_nlp_distributed.py."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 data_axis: str = "data", **kw):
+        super().__init__(**kw)
+        self._init_mesh(mesh, data_axis)
+
+    def _pair_round_batch(self, B: int) -> int:
+        return self._require_divisible(B)
+
+    def _pair_place(self, arr):
+        return self._shard_dim(arr, 1)
+
+
+class DistributedGlove(_MeshMixin, Glove):
+    """GloVe with the co-occurrence AdaGrad regression data-parallel over a
+    mesh axis — the `dl4j-spark-nlp/.../models/embeddings/glove/Glove.java`
+    + `glove/cooccurrences/CoOccurrenceCalculator.java` capability,
+    TPU-first: co-occurrence triples are accumulated host-side per corpus
+    shard and merged (the CoOccurrenceCalculator map/reduce), then each
+    AdaGrad batch is sharded over the data axis with replicated
+    parameters; XLA's gradient psum makes every step an exact global batch
+    (parameter-identical to single-device — batch_size must divide the
+    axis — unlike the reference's per-partition updates)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 data_axis: str = "data", **kw):
+        super().__init__(**kw)
+        self._init_mesh(mesh, data_axis)
+
+    def _batch_round(self, B: int) -> int:
+        return self._require_divisible(B)
+
+    def _place(self, arr):
+        return self._shard_dim(arr, 0)

@@ -132,16 +132,33 @@ class Glove(WordVectorsModel):
 
         rng = np.random.default_rng(self.seed)
         n = len(x)
-        B = self.batch_size
+        B = self._batch_round(self.batch_size)
         for _ in range(self.epochs):
             perm = rng.permutation(n)
             for s in range(0, n, B):
                 sl = perm[s:s + B]
+                i, j = rows[sl], cols[sl]
+                lx, f = logx[sl], fx[sl]
+                pad = (-len(i)) % B
+                if pad:
+                    # f=0 padding triples: exact no-ops (every gradient
+                    # term carries the f weight)
+                    i = np.concatenate([i, np.zeros(pad, i.dtype)])
+                    j = np.concatenate([j, np.zeros(pad, j.dtype)])
+                    lx = np.concatenate([lx, np.zeros(pad, lx.dtype)])
+                    f = np.concatenate([f, np.zeros(pad, f.dtype)])
                 params, hist, _ = step(params, hist,
-                                       jnp.asarray(rows[sl]),
-                                       jnp.asarray(cols[sl]),
-                                       jnp.asarray(logx[sl]),
-                                       jnp.asarray(fx[sl]))
+                                       self._place(jnp.asarray(i)),
+                                       self._place(jnp.asarray(j)),
+                                       self._place(jnp.asarray(lx)),
+                                       self._place(jnp.asarray(f)))
         # final embeddings: w + wc (standard GloVe)
         self.lookup_table.syn0 = params["w"] + params["wc"]
         return self
+
+    # hooks for the distributed subclass (nlp/distributed.py)
+    def _batch_round(self, B: int) -> int:
+        return B
+
+    def _place(self, arr):
+        return arr

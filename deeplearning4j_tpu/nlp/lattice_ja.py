@@ -1,0 +1,296 @@
+"""Dictionary-backed lattice tokenizer for Japanese — Viterbi path over a
+bundled lexicon.
+
+The capability of the reference's vendored Kuromoji analyzer
+(`deeplearning4j-nlp-japanese/src/main/java/com/atilika/kuromoji/viterbi/
+ViterbiSearcher.java`, `ViterbiBuilder.java`, `dict/TokenInfoDictionary.java`,
+`dict/UnknownDictionary.java`, `dict/ConnectionCosts.java`) at reduced
+dictionary scale:
+
+  * a bundled lexicon of high-frequency surface forms with word costs and
+    coarse part-of-speech classes (Kuromoji: IPADIC token-info entries);
+  * unknown-word edge generation by character script class — same-script
+    runs become candidate edges with length-dependent costs (Kuromoji's
+    `UnknownDictionary` + `CharacterDefinition` do exactly this);
+  * a coarse-class connection-cost matrix (Kuromoji: the IPADIC
+    left-id/right-id matrix, here collapsed to POS classes);
+  * exact min-cost path by Viterbi DP over the lattice
+    (`ViterbiSearcher.search`).
+
+The lexicon is deliberately small (hundreds of entries, the closed-class
+vocabulary plus very frequent content words): closed-class coverage is what
+separates は-as-particle from は-inside-a-word, which is the failure mode of
+script-run segmentation. Unknown open-class words are still segmented
+correctly as script runs *between* the closed-class anchors.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LatticeTokenizer", "JA_LEXICON"]
+
+# ---------------------------------------------------------------------------
+# Coarse POS classes (collapsed left/right context ids)
+# ---------------------------------------------------------------------------
+NOUN = "N"          # nouns, pronouns, numbers
+PART = "P"          # case/topic particles (postpositions)
+VERB = "V"          # verb stems / conjugated forms
+AUX = "A"           # auxiliaries, copula, polite endings
+ADJ = "J"           # adjectives
+ADV = "D"           # adverbs / conjunctions / interjections
+SUF = "S"           # suffixes (counters, honorifics, nominalizers)
+UNK = "U"           # unknown (script-run) words
+BOS = "^"
+EOS = "$"
+
+# connection costs between coarse classes: row = left (previous word's
+# class), col = right (next word's class). Negative = favored transition.
+# Scale is arbitrary; only relative order matters for the argmin path.
+_CONN: Dict[Tuple[str, str], int] = {}
+
+
+def _conn_default(a: str, b: str) -> int:
+    return 30
+
+
+def _set(a: str, b: str, cost: int):
+    _CONN[(a, b)] = cost
+
+
+for _right in (NOUN, VERB, ADJ, ADV, UNK):
+    _set(BOS, _right, 0)
+_set(BOS, PART, 90)      # sentences rarely start with a particle
+_set(BOS, AUX, 80)
+_set(BOS, SUF, 90)
+for _left in (NOUN, UNK, SUF):
+    _set(_left, PART, -30)   # noun -> particle: the canonical bigram
+    _set(_left, AUX, -5)     # noun -> copula (です/だ)
+    _set(_left, SUF, -10)    # noun -> suffix (さん/たち/語)
+    _set(_left, NOUN, 15)    # compound nouns exist but are dispreferred
+    _set(_left, VERB, 5)
+for _x in (NOUN, VERB, ADJ, ADV, UNK):
+    _set(PART, _x, -10)      # particle -> content word
+_set(PART, PART, 80)         # には/では are their own entries — chains of
+_set(PART, AUX, 70)          # bare particles are almost always missegmented
+                             # kana words (IPADIC encodes this in its ids)
+_set(VERB, AUX, -40)         # verb stem -> ます/ました/たい
+_set(VERB, VERB, 10)         # compound verbs / te-form chains
+_set(VERB, PART, 0)          # 行くのは / 食べてから
+_set(VERB, NOUN, 25)
+_set(AUX, AUX, -15)          # まし+た / てい+ます chains
+_set(AUX, EOS, -30)
+_set(AUX, PART, 15)          # ですか/ですね (sentence-final particles)
+_set(ADJ, NOUN, -10)         # adjective -> noun
+_set(ADJ, AUX, -10)          # 大きいです
+_set(ADV, VERB, -10)
+for _left in (NOUN, VERB, AUX, UNK, SUF, PART):
+    _CONN.setdefault((_left, EOS), 0)
+
+
+# ---------------------------------------------------------------------------
+# Bundled lexicon: surface -> (cost, class). Lower cost = stronger word.
+# Closed-class entries (particles/auxiliaries) carry very low costs so the
+# Viterbi path anchors on them.
+# ---------------------------------------------------------------------------
+def _entries(cls: str, cost: int, words: str) -> List[Tuple[str, int, str]]:
+    return [(w, cost, cls) for w in words.split()]
+
+
+_LEX_SRC: List[Tuple[str, int, str]] = []
+# particles (case markers, topic, conjunctive)
+_LEX_SRC += _entries(PART, -60, "は が を に で と へ も の や か ね よ "
+                                "わ ぞ さ から まで より こそ しか でも "
+                                "など って ば たり し のに ので けど "
+                                "けれど ながら には では とは への")
+# copula / polite auxiliaries / verbal endings
+_LEX_SRC += _entries(AUX, -55, "です だ でした だった ます ました ません "
+                               "ませ ない なかった たい たく て で た "
+                               "いる いた います いました ある あります "
+                               "ありました れる られる せる させる う よう "
+                               "だろう でしょう そうだ ようだ らしい")
+# demonstratives & pronouns
+_LEX_SRC += _entries(NOUN, -40, "これ それ あれ どれ ここ そこ あそこ どこ "
+                                "この その あの どの こちら そちら だれ 誰 "
+                                "何 なに 私 僕 俺 君 彼 彼女 あなた 皆 "
+                                "みんな 自分")
+# very frequent nouns
+_LEX_SRC += _entries(NOUN, -25, "人 日 時 年 月 今日 明日 昨日 今 時間 "
+                                "学生 先生 学校 大学 会社 仕事 日本 日本語 "
+                                "英語 東京 京都 国 家 水 本 車 電車 駅 道 "
+                                "店 朝 昼 夜 天気 雨 映画 音楽 犬 猫 友達 "
+                                "家族 母 父 子供 名前 話 気 手 目 心 上 下 "
+                                "中 外 前 後 こと もの ところ ため")
+# frequent verbs (dictionary + common conjugated surfaces)
+_LEX_SRC += _entries(VERB, -30, "する します した して しません しよう "
+                                "行く 行き 行きます 行った 行って 来る 来ます "
+                                "来た 来て 食べる 食べ 食べます 食べた 食べて "
+                                "飲む 飲み 飲みます 飲んだ 飲んで 見る 見ます "
+                                "見た 見て 聞く 聞き 聞いた 聞いて 読む 読み "
+                                "読みます 読んだ 読んで 書く 書き 書きます "
+                                "書いた 書いて 話す 話し 話します 話した "
+                                "話して 思う 思い 思います 思った 言う 言い "
+                                "言った 言って 使う 使い 使った 持つ 持ち "
+                                "持った 持って 作る 作り 作った 作って 分かる "
+                                "分かり 分かります 分かった なる なり なります "
+                                "なった なって 買う 買い 買った 買って 勉強 "
+                                "働く 働き 働いて 住む 住んで 会う 会い 会って")
+# adjectives
+_LEX_SRC += _entries(ADJ, -25, "大きい 小さい 新しい 古い いい 良い 悪い "
+                               "高い 安い 長い 短い 暑い 寒い 早い 遅い "
+                               "多い 少ない 面白い 楽しい 難しい 簡単 綺麗 "
+                               "きれい 元気 好き 嫌い 上手 下手 おいしい "
+                               "美味しい")
+# adverbs / conjunctions
+_LEX_SRC += _entries(ADV, -25, "とても すこし 少し もう まだ また いつも "
+                               "時々 たくさん ちょっと そして でも しかし "
+                               "だから では はい いいえ")
+# suffixes
+_LEX_SRC += _entries(SUF, -35, "さん ちゃん 君 様 たち 達 語 人 中 的 年 "
+                               "月 日 時 分 円 歳")
+
+# frequent proper nouns (surnames/places — IPADIC's proper-noun entries;
+# without them 田中 loses to 田+中(suffix))
+_LEX_SRC += _entries(NOUN, -30, "田中 山田 鈴木 佐藤 高橋 伊藤 渡辺 中村 "
+                                "小林 加藤 大阪 名古屋 横浜 北海道 九州 "
+                                "沖縄 富士山 アメリカ 中国 韓国 フランス")
+# hiragana spellings of common content words (kana-only text has no kanji
+# anchors; IPADIC carries these as separate entries)
+_LEX_SRC += _entries(NOUN, -30, "すし さかな ねこ いぬ ごはん みず おちゃ "
+                                "ひと くるま うち こども")
+_LEX_SRC += _entries(VERB, -30, "たべ たべる のむ のみ みる いく いき かう "
+                                "かい よむ よみ はなし はなす")
+
+JA_LEXICON: Dict[str, List[Tuple[int, str]]] = {}
+for _w, _c, _cls in _LEX_SRC:
+    cost = _c - 22 * (len(_w) - 1)   # longest-match bias: longer
+    # dictionary entries are exponentially rarer as char sequences, so a
+    # per-char bonus approximates the IPADIC frequency costs
+    if (len(_w) == 1 and _cls == NOUN
+            and 0x4E00 <= ord(_w) <= 0x9FFF):
+        # single-kanji nouns (日/中/本/人...) appear inside compounds far
+        # more often than as standalone words — weaken them so unknown
+        # compound runs (田中) stay whole
+        cost = -8
+    JA_LEXICON.setdefault(_w, []).append((cost, _cls))
+
+
+# ---------------------------------------------------------------------------
+# Script classes for unknown-word edges (CharacterDefinition analog)
+# ---------------------------------------------------------------------------
+def _script(ch: str) -> str:
+    cp = ord(ch)
+    if 0x3041 <= cp <= 0x309F:
+        return "hira"
+    if 0x30A0 <= cp <= 0x30FF or cp == 0x30FC:
+        return "kata"
+    if 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF:
+        return "kanji"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+# unknown-word base costs per script (Kuromoji UnknownDictionary invoke
+# costs, coarsened): katakana/latin runs are usually one word (cheap long
+# edges); kanji compounds favor 1-2 char pieces; hiragana unknowns are
+# heavily penalized (hiragana is closed-class territory — particles and
+# endings should win).
+_UNK_BASE = {"kanji": 45, "kata": 15, "latin": 10, "hira": 95}
+_UNK_PER_CHAR = {"kanji": 5, "kata": 2, "latin": 1, "hira": 40}
+_UNK_MAX_LEN = {"kanji": 4, "kata": 24, "latin": 48, "hira": 6}
+
+
+class LatticeTokenizer:
+    """Viterbi lattice tokenizer over a surface lexicon + unknown-word
+    script edges. `tokenize` returns surface tokens; `tokenize_tagged`
+    returns (surface, coarse_class) pairs."""
+
+    def __init__(self, lexicon: Optional[Dict] = None):
+        self.lexicon = lexicon if lexicon is not None else JA_LEXICON
+        self._max_word = max((len(w) for w in self.lexicon), default=1)
+
+    def _edges(self, text: str, i: int):
+        """Candidate edges starting at position i: (end, cost, cls)."""
+        out = []
+        # dictionary edges
+        for L in range(1, min(self._max_word, len(text) - i) + 1):
+            surf = text[i:i + L]
+            for cost, cls in self.lexicon.get(surf, ()):
+                out.append((i + L, cost, cls))
+        # unknown-word edges over same-script runs
+        s = _script(text[i])
+        if s in _UNK_BASE:
+            run_end = i + 1
+            while (run_end < len(text) and run_end - i < _UNK_MAX_LEN[s]
+                   and _script(text[run_end]) == s):
+                run_end += 1
+            # emit prefixes of the run (kanji: each length; kata/latin:
+            # prefer the full run, Kuromoji groups those scripts)
+            lengths = (range(1, run_end - i + 1) if s in ("kanji", "hira")
+                       else [run_end - i])
+            for L in lengths:
+                cost = _UNK_BASE[s] + _UNK_PER_CHAR[s] * L
+                out.append((i + L, cost, UNK))
+        if not out:  # always offer the single char so the DP can't strand
+            out.append((i + 1, 200, UNK))
+        return out
+
+    def tokenize_tagged(self, text: str) -> List[Tuple[str, str]]:
+        toks: List[Tuple[str, str]] = []
+        for seg in self._segments(text):
+            toks.extend(self._viterbi(seg))
+        return toks
+
+    def tokenize(self, text: str) -> List[str]:
+        return [t for t, _ in self.tokenize_tagged(text)]
+
+    # -- internals -------------------------------------------------------
+    def _segments(self, text: str) -> List[str]:
+        """Split on whitespace/punctuation (lattice runs per segment, the
+        way Kuromoji splits on its DOT/punctuation boundaries)."""
+        segs, cur = [], []
+        for ch in text:
+            if _script(ch) in ("space", "punct"):
+                if cur:
+                    segs.append("".join(cur))
+                    cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            segs.append("".join(cur))
+        return segs
+
+    def _viterbi(self, seg: str) -> List[Tuple[str, str]]:
+        n = len(seg)
+        # best[i] = {cls: (cost, back_pos, back_cls, word)}
+        best: List[Dict[str, Tuple[float, int, str, str]]] = [
+            {} for _ in range(n + 1)]
+        best[0][BOS] = (0.0, -1, "", "")
+        for i in range(n):
+            if not best[i]:
+                continue
+            for end, wcost, cls in self._edges(seg, i):
+                surf = seg[i:end]
+                for lcls, (lcost, *_rest) in best[i].items():
+                    conn = _CONN.get((lcls, cls), _conn_default(lcls, cls))
+                    tot = lcost + conn + wcost
+                    cur = best[end].get(cls)
+                    if cur is None or tot < cur[0]:
+                        best[end][cls] = (tot, i, lcls, surf)
+        # close with EOS
+        final = None
+        for lcls, (lcost, *_r) in best[n].items():
+            tot = lcost + _CONN.get((lcls, EOS), _conn_default(lcls, EOS))
+            if final is None or tot < final[0]:
+                final = (tot, lcls)
+        # backtrack
+        out: List[Tuple[str, str]] = []
+        pos, cls = n, final[1]
+        while pos > 0:
+            cost, back_pos, back_cls, surf = best[pos][cls]
+            out.append((surf, cls))
+            pos, cls = back_pos, back_cls
+        out.reverse()
+        return out

@@ -9,14 +9,16 @@ Reference analogs (SURVEY.md §2.5):
     (lightweight lexical/suffix tagger), composed by
     `PipelineTokenizerFactory` — same plugin surface, no UIMA runtime.
   * deeplearning4j-nlp-japanese — vendored Kuromoji
-    (`com/atilika/kuromoji/**`): `JapaneseTokenizer` segments by script
-    class (kanji/hiragana/katakana/latin runs, with hiragana particles
-    split off). A dictionary-less approximation of Kuromoji granularity —
-    the plugin surface and factory contract match; swap in a dictionary
-    tokenizer via the same TokenizerFactory SPI for morphological accuracy.
+    (`com/atilika/kuromoji/**`, `viterbi/ViterbiSearcher.java`):
+    `JapaneseTokenizer` now runs the dictionary-backed lattice tokenizer
+    (`lattice_ja.LatticeTokenizer`) — Viterbi min-cost path over a bundled
+    lexicon + script-class unknown-word edges + a coarse connection-cost
+    matrix, i.e. the Kuromoji architecture at reduced dictionary scale.
+    `use_lattice=False` falls back to the round-2 script-run segmentation.
   * deeplearning4j-nlp-korean — KoreanTokenizer over twitter-korean-text:
-    here whitespace segmentation plus splitting common josa (particles)
-    off Hangul tokens.
+    whitespace segmentation + splitting josa (case particles) and common
+    verb/adjective endings off Hangul tokens, with an eomi (ending)
+    lexicon ordered longest-first.
 """
 from __future__ import annotations
 
@@ -274,36 +276,35 @@ class PipelineTokenizerFactory(TokenizerFactory):
 # Japanese (Kuromoji-analog surface)
 # ---------------------------------------------------------------------------
 
-_HIRAGANA = (0x3041, 0x309F)
-_KATAKANA = (0x30A0, 0x30FF)
-_KANJI = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF))
-_CHOON = 0x30FC  # prolonged sound mark, stays with katakana runs
+# the canonical script-classification table lives with the lattice
+# tokenizer (one source of truth for both segmentation paths)
+from .lattice_ja import _script  # noqa: E402
 
 # common hiragana particles split off as their own tokens (は/が/を/に/…)
+# — used only by the script-run fallback path
 _JA_PARTICLES = {"は", "が", "を", "に", "で", "と", "へ", "も", "の",
                  "や", "か", "ね", "よ", "から", "まで", "より"}
 
 
-def _script(ch: str) -> str:
-    cp = ord(ch)
-    if _HIRAGANA[0] <= cp <= _HIRAGANA[1]:
-        return "hira"
-    if _KATAKANA[0] <= cp <= _KATAKANA[1] or cp == _CHOON:
-        return "kata"
-    if any(lo <= cp <= hi for lo, hi in _KANJI):
-        return "kanji"
-    if ch.isalnum():
-        return "latin"
-    if ch.isspace():
-        return "space"
-    return "punct"
-
-
 class JapaneseTokenizer(Tokenizer):
-    """Script-run segmentation with particle splitting (see module
-    docstring for scope vs the vendored Kuromoji)."""
+    """Dictionary-backed lattice segmentation (Kuromoji capability analog,
+    `ViterbiSearcher.java`); `use_lattice=False` selects the round-2
+    script-run fallback."""
 
-    def __init__(self, text: str, preprocessor=None):
+    _lattice = None  # shared stateless instance (lexicon is immutable);
+    # corpus tokenization calls factory.create per sentence, so per-call
+    # construction + lexicon scans would be pure overhead
+
+    def __init__(self, text: str, preprocessor=None,
+                 use_lattice: bool = True):
+        if use_lattice:
+            if JapaneseTokenizer._lattice is None:
+                from .lattice_ja import LatticeTokenizer
+
+                JapaneseTokenizer._lattice = LatticeTokenizer()
+            super().__init__(JapaneseTokenizer._lattice.tokenize(text),
+                             preprocessor)
+            return
         runs: List[str] = []
         cur, cur_script = [], None
         for ch in text:
@@ -340,20 +341,37 @@ class JapaneseTokenizer(Tokenizer):
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    def __init__(self):
+    def __init__(self, use_lattice: bool = True):
         self._pre = None
+        self.use_lattice = use_lattice
 
     def create(self, text: str) -> Tokenizer:
-        return JapaneseTokenizer(text, self._pre)
+        return JapaneseTokenizer(text, self._pre,
+                                 use_lattice=self.use_lattice)
 
 
 # ---------------------------------------------------------------------------
 # Korean (twitter-korean-text-analog surface)
 # ---------------------------------------------------------------------------
 
-_KO_JOSA = ("은", "는", "이", "가", "을", "를", "의", "에", "와", "과",
-            "도", "만", "으로", "로", "에서", "에게", "까지", "부터",
-            "입니다", "습니다")
+# case/topic particles (josa), sorted longest-first ONCE at module load
+_KO_JOSA = tuple(sorted(
+    ("에게서", "으로서", "으로써", "한테서", "에서는", "에서도",
+     "은", "는", "이", "가", "을", "를", "의", "에", "와", "과",
+     "도", "만", "으로", "로", "에서", "에게", "한테", "까지",
+     "부터", "처럼", "보다", "마다", "조차", "밖에", "이나", "나",
+     "라고", "하고", "께서"), key=len, reverse=True))
+
+# verb/adjective endings (eomi) incl. the polite/formal paradigm — split
+# off so stems unify across conjugations (twitter-korean-text's stemmer
+# behavior), sorted longest-first ONCE at module load
+_KO_EOMI = tuple(sorted(
+    ("했습니다", "합니다", "입니다", "습니다", "었습니다",
+     "겠습니다", "하였습니다", "하세요", "했어요", "해요", "이에요",
+     "예요", "어요", "아요", "았어요", "었어요", "게요", "네요",
+     "데요", "지요", "죠", "한다", "하다", "이다", "았다", "었다",
+     "했다", "ㄴ다", "며", "면서", "려고", "지만", "는데", "아서",
+     "어서", "고"), key=len, reverse=True))
 
 
 def _is_hangul(ch: str) -> bool:
@@ -361,8 +379,21 @@ def _is_hangul(ch: str) -> bool:
 
 
 class KoreanTokenizer(Tokenizer):
-    """Whitespace segmentation + splitting common josa (particles) off
-    Hangul tokens."""
+    """Whitespace segmentation + splitting josa (case particles) and
+    common verb/adjective endings off Hangul tokens (twitter-korean-text
+    capability analog at reduced dictionary scale)."""
+
+    def _split_suffix(self, word: str, suffixes,
+                      strict_short: bool = False) -> Optional[Tuple[str, str]]:
+        for suf in suffixes:  # pre-sorted longest-first
+            # strict_short (eomi): single-syllable endings (고/죠) need a
+            # 2-syllable stem — very common two-char nouns (최고/사고/창고)
+            # end in the same syllable and must stay whole. Josa keep a
+            # 1-syllable stem (나+는, 저+는 are canonical).
+            min_stem = 2 if (strict_short and len(suf) == 1) else 1
+            if word.endswith(suf) and len(word) - len(suf) >= min_stem:
+                return word[: -len(suf)], suf
+        return None
 
     def __init__(self, text: str, preprocessor=None):
         toks: List[str] = []
@@ -370,14 +401,17 @@ class KoreanTokenizer(Tokenizer):
             word = raw.strip("\"'.,!?()[]{}:;")
             if not word:
                 continue
-            if all(_is_hangul(c) for c in word) and len(word) > 1:
-                for josa in sorted(_KO_JOSA, key=len, reverse=True):
-                    if word.endswith(josa) and len(word) > len(josa):
-                        toks.append(word[: -len(josa)])
-                        toks.append(josa)
-                        break
-                else:
-                    toks.append(word)
+            if not (all(_is_hangul(c) for c in word) and len(word) > 1):
+                toks.append(word)
+                continue
+            # endings first (longer, sentence-final), then josa — a polite
+            # verb like 공부했습니다 yields 공부 + 했습니다; a marked noun
+            # like 학생은 yields 학생 + 은
+            split = self._split_suffix(word, _KO_EOMI, strict_short=True)
+            if split is None:
+                split = self._split_suffix(word, _KO_JOSA)
+            if split is not None:
+                toks.extend(split)
             else:
                 toks.append(word)
         super().__init__(toks, preprocessor)

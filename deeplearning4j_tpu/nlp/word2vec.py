@@ -262,7 +262,7 @@ class SequenceVectors(WordVectorsModel):
                 n = len(centers)
                 perm = self._np_rng.permutation(n)
                 centers, contexts = centers[perm], contexts[perm]
-                B = self.batch_size
+                B = self._pair_round_batch(self.batch_size)
                 pad = (-n) % B
                 if pad:
                     centers = np.concatenate([centers, centers[:pad]])
@@ -292,9 +292,9 @@ class SequenceVectors(WordVectorsModel):
                     runner = runners[kind] = make_epoch_runner(step)
                 syn0, syn1, syn1neg, _loss = runner(
                     syn0, syn1, syn1neg,
-                    jnp.asarray(centers.reshape((T2, B))),
-                    jnp.asarray(contexts.reshape(
-                        (T2, B) + contexts.shape[1:])),
+                    self._pair_place(jnp.asarray(centers.reshape((T2, B)))),
+                    self._pair_place(jnp.asarray(contexts.reshape(
+                        (T2, B) + contexts.shape[1:]))),
                     jnp.asarray(lrs, jnp.float32), keys)
                 done += T * B
         table.syn0 = syn0
@@ -366,12 +366,20 @@ class SequenceVectors(WordVectorsModel):
         table.syn1neg = syn1neg
         return self
 
-    # hooks for the distributed subclass (nlp/distributed.py)
+    # hooks for the distributed subclasses (nlp/distributed.py)
     def _sg_round_batch(self, B: int) -> int:
         return B
 
     def _sg_place_positions(self, pos):
         return pos
+
+    def _pair_round_batch(self, B: int) -> int:
+        """Pair-path (sg/cbow/dbow/dm) batch rounding hook."""
+        return B
+
+    def _pair_place(self, arr):
+        """Pair-path batch placement hook ([T, B, ...] arrays)."""
+        return arr
 
 
 class Word2Vec(SequenceVectors):

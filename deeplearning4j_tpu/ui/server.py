@@ -34,6 +34,7 @@ th{background:#eee} svg{background:#fff;border:1px solid #ddd}
 <div id="meta"></div>
 <div><span class="tab active" data-p="overview">Overview</span>
 <span class="tab" data-p="model">Model</span>
+<span class="tab" data-p="flow">Flow</span>
 <span class="tab" data-p="histograms">Histograms</span>
 <span class="tab" data-p="system">System</span></div>
 <div id="content"></div>
@@ -67,6 +68,41 @@ function line(xs,ys,w,h,color){
     '<text x="4" y="12" font-size="10">'+mx.toPrecision(4)+'</text>'+
     '<text x="4" y="'+(h-2)+'" font-size="10">'+mn.toPrecision(4)+'</text></svg>';
 }
+function flow(model,params){
+  // FlowListenerModule analog: vertices laid out by topological depth,
+  // edges as lines, per-vertex param counts + latest param stdev
+  if(!model||!model.length) return '<p>no model info in this session</p>';
+  const depth={}, rows={}, pos={};
+  model.forEach(v=>{
+    depth[v.name]=v.inputs.length?
+      1+Math.max(...v.inputs.map(i=>depth[i]??0)):0;});
+  model.forEach(v=>{
+    const d=depth[v.name]; rows[d]=(rows[d]??0);
+    pos[v.name]=[d,rows[d]]; rows[d]++;});
+  const BW=140,BH=40,GX=40,GY=14;
+  const W=(Math.max(...Object.values(depth))+1)*(BW+GX)+20;
+  const H=(Math.max(...Object.values(rows))+0)*(BH+GY)+20;
+  let s='<svg width="'+W+'" height="'+H+'">';
+  const xy=n=>{const p=pos[n];
+    return [10+p[0]*(BW+GX), 10+p[1]*(BH+GY)];};
+  model.forEach(v=>v.inputs.forEach(i=>{
+    if(!(i in pos)) return;
+    const a=xy(i), b=xy(v.name);
+    s+='<line x1="'+(a[0]+BW)+'" y1="'+(a[1]+BH/2)+'" x2="'+b[0]+
+      '" y2="'+(b[1]+BH/2)+'" stroke="#999"/>';}));
+  model.forEach(v=>{
+    const p=xy(v.name);
+    const st=Object.entries(params).find(([k,_])=>k.startsWith(v.name+'/'));
+    s+='<rect x="'+p[0]+'" y="'+p[1]+'" width="'+BW+'" height="'+BH+
+      '" rx="4" fill="'+(v.type=='Input'?'#dde':'#fff')+
+      '" stroke="#36c"/>'+
+      '<text x="'+(p[0]+5)+'" y="'+(p[1]+14)+'" font-size="11" '+
+      'font-weight="bold">'+v.name+'</text>'+
+      '<text x="'+(p[0]+5)+'" y="'+(p[1]+27)+'" font-size="9">'+v.type+
+      ' · '+v.n_params+'p'+(st?' · σ '+st[1].stdev.toPrecision(2):'')+
+      '</text>';});
+  return s+'</svg>';
+}
 async function refresh(){
   const d=await (await fetch('/train/data.json')).json();
   document.getElementById('meta').textContent=
@@ -88,6 +124,8 @@ async function refresh(){
     html+='</table>';
     html+='<h2>Mean parameter stdev vs iteration</h2>'+
       line(d.iterations,d.param_stdev,640,140,'#393');
+  } else if(page=='flow'){
+    html+='<h2>Network structure</h2>'+flow(d.model,d.params);
   } else if(page=='histograms'){
     for(const [k,v] of Object.entries(d.params)){
       html+='<h2>'+k+'</h2>'+bars(v.histogram,320,110,'#36c');
@@ -234,6 +272,7 @@ class UIServer:
             param_stdev.append(
                 float(np.mean([v["stdev"] for v in ps.values()]))
                 if ps else 0.0)
+        model = next((r["model"] for r in reports if "model" in r), [])
         return {
             "session": sid,
             "iterations": [r.get("iteration", i)
@@ -245,6 +284,7 @@ class UIServer:
             "param_stdev": param_stdev,
             "params": latest.get("params", {}),
             "updates": latest.get("updates", {}),
+            "model": model,
         }
 
     # -- lifecycle -------------------------------------------------------

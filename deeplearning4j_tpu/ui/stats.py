@@ -18,7 +18,7 @@ import numpy as np
 from .storage import StatsStorage
 from ..optimize.listeners import TrainingListener
 
-__all__ = ["StatsListener", "StatsReport"]
+__all__ = ["StatsListener", "StatsReport", "model_topology"]
 
 
 def _flatten_params(model) -> Dict[str, np.ndarray]:
@@ -55,9 +55,51 @@ def _summary(arr: np.ndarray, bins: int) -> Dict:
     }
 
 
+def _param_count(p) -> int:
+    if not p:
+        return 0
+    return int(sum(np.asarray(v).size for v in p.values()))
+
+
+def model_topology(model) -> List[Dict]:
+    """Vertex list for the Flow view (reference FlowListenerModule /
+    ModelInfo): [{name, type, inputs, n_params}] in topological order.
+    Works for ComputationGraph (DAG) and MultiLayerNetwork (chain)."""
+    conf = getattr(model, "conf", None)
+    out: List[Dict] = []
+    if hasattr(conf, "vertices"):  # ComputationGraph
+        for name in conf.network_inputs:
+            out.append({"name": name, "type": "Input", "inputs": [],
+                        "n_params": 0})
+        params = model.params or {}
+        for name in conf.topological_order:
+            if name not in conf.vertices:
+                continue
+            v = conf.vertices[name]
+            out.append({"name": name, "type": type(v).__name__,
+                        "inputs": list(conf.vertex_inputs[name]),
+                        "n_params": _param_count(params.get(name))})
+        return out
+    # MultiLayerNetwork: sequential chain
+    out.append({"name": "input", "type": "Input", "inputs": [],
+                "n_params": 0})
+    prev = "input"
+    params = model.params or ()
+    for i, layer in enumerate(getattr(model, "layers", ())):
+        name = getattr(layer, "name", None) or f"layer{i}"
+        out.append({"name": name, "type": type(layer).__name__,
+                    "inputs": [prev],
+                    "n_params": _param_count(
+                        params[i] if i < len(params) else {})})
+        prev = name
+    return out
+
+
 class StatsReport(dict):
     """A plain-dict report (JSON-able). Keys: iteration, timestamp, score,
-    params {name: summary}, updates {name: summary}, memory, perf."""
+    params {name: summary}, updates {name: summary}, memory, perf, and (on
+    the first report of a session) model — the topology for the Flow
+    view."""
 
 
 class StatsListener(TrainingListener):
@@ -77,6 +119,7 @@ class StatsListener(TrainingListener):
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
         self._last_time: Optional[float] = None
         self._last_iter: Optional[int] = None
+        self._sent_model = False
 
     def iteration_done(self, model, iteration: int):
         if iteration % self.frequency != 0:
@@ -84,6 +127,15 @@ class StatsListener(TrainingListener):
         now = time.time()
         report = StatsReport(iteration=int(iteration), timestamp=now,
                              score=float(model.score()))
+        if not self._sent_model:
+            # topology travels with the FIRST report (the reference's
+            # StatsInitializationReport carries the model info the Flow
+            # module renders)
+            try:
+                report["model"] = model_topology(model)
+            except Exception:
+                pass
+            self._sent_model = True
 
         params = _flatten_params(model)
         if self.collect_histograms:

@@ -257,3 +257,72 @@ def test_storage_url_rewrite():
     assert _to_https("s3://bucket/k.bin") == \
         "https://bucket.s3.amazonaws.com/k.bin"
     assert _to_https("https://x/y") == "https://x/y"
+
+
+# ----------------- lattice Japanese / Korean morphology (round 3) ---------
+
+def test_japanese_lattice_segments_real_sentences():
+    """Full-sequence segmentation on real Japanese — the Kuromoji-capability
+    gate (ViterbiSearcher.java analog). Script-run segmentation CANNOT
+    produce these: これは is one hiragana run; 学生です crosses scripts at
+    the right places only with dictionary knowledge."""
+    tf = JapaneseTokenizerFactory()
+    assert tf.create("私は学生です。").get_tokens() == \
+        ["私", "は", "学生", "です"]
+    assert tf.create("これはペンです。").get_tokens() == \
+        ["これ", "は", "ペン", "です"]
+    assert tf.create("東京に行きます。").get_tokens() == \
+        ["東京", "に", "行き", "ます"]
+    assert tf.create("犬と猫が好きです。").get_tokens() == \
+        ["犬", "と", "猫", "が", "好き", "です"]
+    # kana-only sentence: no script boundaries at all
+    assert tf.create("すしをたべたい。").get_tokens() == \
+        ["すし", "を", "たべ", "たい"]
+
+
+def test_japanese_lattice_unknown_words():
+    """OOV handling: unknown kanji compounds and katakana loans stay whole
+    (UnknownDictionary script-grouping analog) while the particles around
+    them anchor the path."""
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("田中さんは会社で働いています。").get_tokens()
+    assert toks[:4] == ["田中", "さん", "は", "会社"]
+    toks = tf.create("コンピュータを使います。").get_tokens()
+    assert toks[0] == "コンピュータ" and toks[1] == "を"
+
+
+def test_japanese_lattice_tagged_classes():
+    from deeplearning4j_tpu.nlp.lattice_ja import LatticeTokenizer
+
+    tagged = LatticeTokenizer().tokenize_tagged("私は学生です")
+    assert tagged == [("私", "N"), ("は", "P"), ("学生", "N"), ("です", "A")]
+
+
+def test_japanese_script_run_fallback_still_available():
+    tf = JapaneseTokenizerFactory(use_lattice=False)
+    toks = tf.create("東京タワーへ行きます。").get_tokens()
+    assert "東京" in toks and "タワー" in toks
+
+
+def test_korean_tokenizer_splits_eomi_and_josa():
+    """Polite verb endings split from stems; case particles split from
+    nouns (twitter-korean-text capability)."""
+    tf = KoreanTokenizerFactory()
+    assert tf.create("저는 학생입니다.").get_tokens() == \
+        ["저", "는", "학생", "입니다"]
+    assert tf.create("한국어를 공부했습니다.").get_tokens() == \
+        ["한국어", "를", "공부", "했습니다"]
+    toks = tf.create("서울에서 부산까지 갑니다.").get_tokens()
+    assert "서울" in toks and "에서" in toks
+    assert "부산" in toks and "까지" in toks
+
+
+def test_korean_single_syllable_eomi_guard():
+    """Two-syllable nouns ending in an eomi syllable (최고/사고/창고) must
+    stay whole; single-syllable pronoun + josa still splits (round-3
+    review regression)."""
+    tf = KoreanTokenizerFactory()
+    for w in ("최고", "사고", "창고", "금고"):
+        assert tf.create(w).get_tokens() == [w], w
+    assert tf.create("나는").get_tokens() == ["나", "는"]
+    assert tf.create("공부하고").get_tokens() == ["공부하", "고"]

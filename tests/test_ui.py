@@ -384,3 +384,64 @@ def test_convolutional_listener_stores_activation_grids():
     # width 3*9-1=26, height 2*9-1=17 — pins CONV activations, not the
     # (8x8x1) input image, as the rendered payload
     assert img.mode == "L" and img.size == (26, 17)
+
+
+# ------------------------ Flow module (round 3) ----------------------------
+
+def test_model_topology_graph_and_chain():
+    """FlowListenerModule analog: topology extraction for both model
+    families."""
+    from deeplearning4j_tpu.ui.stats import model_topology
+
+    chain = model_topology(_small_model())
+    assert [v["type"] for v in chain] == ["Input", "DenseLayer",
+                                          "OutputLayer"]
+    assert chain[1]["inputs"] == ["input"]
+    assert chain[1]["n_params"] == 4 * 8 + 8   # W + b
+
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration as NNC
+    from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    b = NNC.builder().seed(0).graph_builder()
+    b.add_inputs("in")
+    b.add_layer("a", DenseLayer(n_out=4, activation="relu"), "in")
+    b.add_layer("b", DenseLayer(n_out=4, activation="relu"), "in")
+    b.add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+    b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "sum")
+    b.set_outputs("out")
+    b.set_input_types(IT.feed_forward(3))
+    g = ComputationGraph(b.build()).init()
+    topo = model_topology(g)
+    names = {v["name"]: v for v in topo}
+    assert names["sum"]["inputs"] == ["a", "b"]
+    assert names["sum"]["type"] == "ElementWiseVertex"
+    assert names["out"]["inputs"] == ["sum"]
+
+
+def test_flow_tab_data_and_storage_round_trip(tmp_path):
+    """Topology travels in the first report, survives the FileStatsStorage
+    round trip, and is served on /train/data.json; the dashboard carries
+    the Flow tab."""
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    listener = StatsListener(storage, session_id="flow-sess")
+    _train(_small_model(), listener, steps=2)
+
+    reloaded = FileStatsStorage(path)
+    ups = reloaded.get_all_updates("flow-sess", StatsListener.TYPE_ID,
+                                   "local")
+    assert "model" in ups[0][1] and "model" not in ups[1][1]
+
+    server = UIServer(port=0).attach(reloaded).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        html = urllib.request.urlopen(base + "/train").read().decode()
+        assert 'data-p="flow"' in html and "function flow(" in html
+        data = json.loads(
+            urllib.request.urlopen(base + "/train/data.json").read())
+        assert [v["name"] for v in data["model"]] == ["input", "layer0",
+                                                      "layer1"]
+    finally:
+        server.stop()
