@@ -5,6 +5,7 @@ from .ring_attention import (blockwise_attention, local_attention_reference,
                              ring_attention_sharded, ring_self_attention)
 from .stats import TrainingStats, profiler_trace
 from .pipeline import (PipelinedDenseStack,
+                       PipelinedGraphTrainer,
                        PipelinedNetworkTrainer, pipeline_forward)
 from .distributed import (global_mesh, initialize, is_multi_host,
                           local_batch_slice, process_index)
@@ -16,7 +17,7 @@ __all__ = [
     "ParallelTrainer", "ParallelWrapper", "TrainingMode",
     "blockwise_attention", "local_attention_reference",
     "ring_attention_sharded", "ring_self_attention",
-    "TrainingStats", "profiler_trace", "PipelinedDenseStack", "PipelinedNetworkTrainer", "pipeline_forward",
+    "TrainingStats", "profiler_trace", "PipelinedDenseStack", "PipelinedNetworkTrainer", "PipelinedGraphTrainer", "pipeline_forward",
     "global_mesh", "initialize", "is_multi_host", "local_batch_slice",
     "process_index",
     "ShardedCheckpoint", "restore_sharded", "save_sharded",

@@ -84,11 +84,16 @@ class ParallelTrainer:
             # stage-partitioned training of a real MultiLayerNetwork: the
             # mesh must carry a "pipe" axis; delegate to the GPipe trainer
             from .mesh import MeshAxes
-            from .pipeline import PipelinedNetworkTrainer
+            from .pipeline import (PipelinedGraphTrainer,
+                                   PipelinedNetworkTrainer)
+            from ..nn.graph import ComputationGraph
 
             axis = (MeshAxes.PIPE if MeshAxes.PIPE in self.mesh.axis_names
                     else data_axis)
-            self._pipe = PipelinedNetworkTrainer(model, self.mesh, axis=axis)
+            cls = (PipelinedGraphTrainer
+                   if isinstance(model, ComputationGraph)
+                   else PipelinedNetworkTrainer)
+            self._pipe = cls(model, self.mesh, axis=axis)
             self.n_data = 1
             self.iteration_count = 0
             return

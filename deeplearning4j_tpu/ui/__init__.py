@@ -12,11 +12,16 @@ is pluggable (in-memory / file), and the dashboard is a dependency-free
 stdlib http.server rendering overview/model/system pages.
 """
 from .stats import StatsListener, StatsReport
-from .storage import (FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+from .storage import (FileStatsStorage, InMemoryStatsStorage,
+                      SqliteStatsStorage, StatsStorage,
                       StatsStorageEvent, StatsStorageListener)
 from .server import UIServer
+from .legacy_listeners import (WebReporter, RemoteFlowIterationListener,
+                               RemoteHistogramIterationListener)
 
 __all__ = [
+    "WebReporter", "RemoteFlowIterationListener",
+    "RemoteHistogramIterationListener", "SqliteStatsStorage",
     "StatsListener", "StatsReport", "StatsStorage", "InMemoryStatsStorage",
     "FileStatsStorage", "StatsStorageEvent", "StatsStorageListener",
     "UIServer",

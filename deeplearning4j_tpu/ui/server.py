@@ -36,6 +36,7 @@ th{background:#eee} svg{background:#fff;border:1px solid #ddd}
 <span class="tab" data-p="model">Model</span>
 <span class="tab" data-p="flow">Flow</span>
 <span class="tab" data-p="histograms">Histograms</span>
+<span class="tab" data-p="tsne">t-SNE</span>
 <span class="tab" data-p="system">System</span></div>
 <div id="content"></div>
 <script>
@@ -126,6 +127,33 @@ async function refresh(){
       line(d.iterations,d.param_stdev,640,140,'#393');
   } else if(page=='flow'){
     html+='<h2>Network structure</h2>'+flow(d.model,d.params);
+  } else if(page=='tsne'){
+    const t=await (await fetch('/tsne/data.json')).json();
+    if(!t.points||!t.points.length){
+      html+='<p>no t-SNE coordinates attached '+
+        '(UIServer.attach_tsne(coords, labels))</p>';
+    } else {
+      const xs=t.points.map(p=>p[0]), ys=t.points.map(p=>p[1]);
+      const mnx=Math.min(...xs), mxx=Math.max(...xs),
+            mny=Math.min(...ys), mxy=Math.max(...ys);
+      const W=640,H=480,pal=['#c33','#36c','#393','#939','#c93','#399',
+                             '#663','#636','#366','#933'];
+      const cls=[...new Set(t.labels??[])];
+      let s='<svg width="'+W+'" height="'+H+'">';
+      t.points.forEach((p,i)=>{
+        const x=10+(p[0]-mnx)/((mxx-mnx)||1)*(W-20);
+        const y=10+(p[1]-mny)/((mxy-mny)||1)*(H-20);
+        const c=t.labels?pal[cls.indexOf(t.labels[i])%pal.length]:'#36c';
+        s+='<circle cx="'+x+'" cy="'+y+'" r="3" fill="'+c+
+          '" fill-opacity="0.7"><title>'+(t.labels?t.labels[i]:i)+
+          '</title></circle>';});
+      html+='<h2>t-SNE embedding ('+t.points.length+' points)</h2>'+
+        s+'</svg>';
+      if(cls.length)
+        html+='<p>'+cls.map((c,i)=>'<span style="color:'+
+          pal[i%pal.length]+'">&#9679; '+c+'</span>').join(' &nbsp; ')+
+          '</p>';
+    }
   } else if(page=='histograms'){
     for(const [k,v] of Object.entries(d.params)){
       html+='<h2>'+k+'</h2>'+bars(v.histogram,320,110,'#36c');
@@ -177,6 +205,9 @@ class _Handler(BaseHTTPRequestHandler):
             session = q.get("session", [None])[0]
             self._json(ui.train_data(session))
             return
+        if url.path == "/tsne/data.json":
+            self._json(ui.tsne_data())
+            return
         self._json({"error": "not found"}, 404)
 
     def do_POST(self):
@@ -214,6 +245,24 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.remote_storage: Optional[StatsStorage] = None
+
+    def attach_tsne(self, coords, labels=None) -> "UIServer":
+        """Attach 2-D embedding coordinates for the t-SNE tab (reference
+        `module/tsne/TsneModule.java`: uploaded coordinate files rendered
+        as a scatter). `coords`: [N, 2] array-like; `labels`: optional N
+        strings for coloring/tooltips."""
+        import numpy as np
+
+        c = np.asarray(coords, dtype=float)
+        if c.ndim != 2 or c.shape[1] < 2:
+            raise ValueError("coords must be [N, >=2]")
+        self._tsne = {"points": c[:, :2].tolist(),
+                      "labels": (None if labels is None
+                                 else [str(l) for l in labels])}
+        return self
+
+    def tsne_data(self) -> dict:
+        return getattr(self, "_tsne", {"points": [], "labels": None})
 
     def enable_remote_listener(self, storage: Optional[StatsStorage] = None
                                ) -> "UIServer":

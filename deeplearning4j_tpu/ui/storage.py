@@ -14,7 +14,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
-           "StatsStorageEvent", "StatsStorageListener"]
+           "SqliteStatsStorage", "StatsStorageEvent", "StatsStorageListener"]
 
 
 class StatsStorageEvent:
@@ -156,3 +156,86 @@ class FileStatsStorage(InMemoryStatsStorage):
         with self._file_lock, open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")
         super().put_update(session_id, type_id, worker_id, timestamp, report)
+
+
+class SqliteStatsStorage(StatsStorage):
+    """SQLite-backed storage (reference `sqlite/J7FileStatsStorage.java` /
+    `mapdb/MapDBStatsStorage.java` role): durable, queryable from other
+    processes, safe for concurrent writers through SQLite's own locking.
+    Reports are stored as JSON text in an indexed (session, type, worker,
+    ts) table."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS updates ("
+                " session TEXT NOT NULL, type TEXT NOT NULL,"
+                " worker TEXT NOT NULL, ts REAL NOT NULL,"
+                " report TEXT NOT NULL)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_updates ON updates"
+                " (session, type, worker, ts)")
+            self._conn.commit()
+
+    def close(self):
+        with self._lock:
+            self._conn.close()
+
+    def put_update(self, session_id, type_id, worker_id, timestamp, report):
+        with self._lock:
+            new_session = not self._conn.execute(
+                "SELECT 1 FROM updates WHERE session=? LIMIT 1",
+                (session_id,)).fetchone()
+            new_worker = not self._conn.execute(
+                "SELECT 1 FROM updates WHERE session=? AND type=? AND "
+                "worker=? LIMIT 1",
+                (session_id, type_id, worker_id)).fetchone()
+            self._conn.execute(
+                "INSERT INTO updates VALUES (?,?,?,?,?)",
+                (session_id, type_id, worker_id, float(timestamp),
+                 json.dumps(report)))
+            self._conn.commit()
+        if new_session:
+            self._notify(StatsStorageEvent(StatsStorageEvent.NEW_SESSION,
+                                           session_id, type_id, worker_id,
+                                           timestamp))
+        if new_worker:
+            self._notify(StatsStorageEvent(StatsStorageEvent.NEW_WORKER,
+                                           session_id, type_id, worker_id,
+                                           timestamp))
+        self._notify(StatsStorageEvent(StatsStorageEvent.POST_UPDATE,
+                                       session_id, type_id, worker_id,
+                                       timestamp))
+
+    def list_session_ids(self):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT session FROM updates ORDER BY rowid")
+            return [r[0] for r in rows]
+
+    def list_type_ids(self, session_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT type FROM updates WHERE session=?",
+                (session_id,))
+            return [r[0] for r in rows]
+
+    def list_worker_ids(self, session_id, type_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT worker FROM updates WHERE session=? AND "
+                "type=?", (session_id, type_id))
+            return [r[0] for r in rows]
+
+    def get_all_updates(self, session_id, type_id, worker_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT ts, report FROM updates WHERE session=? AND type=?"
+                " AND worker=? ORDER BY ts, rowid",
+                (session_id, type_id, worker_id))
+            return [(t, json.loads(r)) for t, r in rows]

@@ -129,3 +129,151 @@ def test_pipeline_cnn_stack_trains():
     for _ in range(10):
         tr.fit(ds)
     assert tr.score() < s0
+
+
+# ------------- ComputationGraph pipeline (round 3) -------------------------
+
+def _tiny_resnet(seed=21):
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    return resnet50(n_classes=4, image=16, seed=seed, blocks=(1, 1),
+                    width=8, compute_dtype=None, updater=Sgd(0.05)).init()
+
+
+def test_graph_clean_cut_detection():
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    t = PipelinedGraphTrainer(_tiny_resnet(), mesh)
+    cuts = t._clean_cuts()
+    # residual spans (top feeds both branch and shortcut) must NOT be cut
+    topo = t._topo
+    for c in cuts:
+        # the boundary value is the single live tensor
+        assert 0 < c < len(topo)
+    # stage partition covers the topo order exactly
+    n0, b0 = t._stage_names(0)
+    n1, b1 = t._stage_names(1)
+    assert n0 + n1 == topo
+    assert b1 == n0[-1]
+
+
+def test_pipelined_graph_matches_single_device():
+    """Pipelined ResNet graph == single-device training (param equality) —
+    closes 'DAG models cannot train through the pipeline' from the r2
+    review. One microbatch: BatchNorm computes batch statistics per
+    microbatch (standard GPipe semantics), so exact equality is defined at
+    M=1; the microbatched schedule is covered by the convergence test
+    below."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    r = np.random.default_rng(5)
+    x = r.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 16)]
+    ds = DataSet(x, y)
+    single = _tiny_resnet(seed=21)
+    piped = _tiny_resnet(seed=21)
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    trainer = PipelinedGraphTrainer(piped, mesh, n_microbatches=1)
+    for _ in range(3):
+        single.fit(ds)
+        trainer.fit(ds)
+    trainer.sync_back()
+    assert abs(trainer.score() - single.score()) < 1e-4
+    for name in single.params:
+        for k in single.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(piped.params[name][k]),
+                np.asarray(single.params[name][k]), rtol=2e-5, atol=1e-6,
+                err_msg=f"{name}/{k}")
+
+
+def test_pipelined_graph_microbatched_trains():
+    """4-stage, 4-microbatch GPipe schedule on the ResNet graph: the loss
+    must decrease (per-microbatch BN stats make it approximate, the same
+    trade every GPipe implementation makes)."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    r = np.random.default_rng(6)
+    x = r.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    yidx = r.integers(0, 4, 16)
+    x += yidx[:, None, None, None] * 0.5    # separable classes
+    y = np.eye(4, dtype=np.float32)[yidx]
+    ds = DataSet(x, y)
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    trainer = PipelinedGraphTrainer(_tiny_resnet(seed=9), mesh,
+                                    n_microbatches=4)
+    trainer.fit(ds)
+    s0 = trainer.score()
+    for _ in range(12):
+        trainer.fit(ds)
+    assert trainer.score() < s0
+
+
+def test_parallel_trainer_pipeline_dispatches_graph():
+    from deeplearning4j_tpu.parallel import (ParallelTrainer,
+                                             ShardingStrategy, make_mesh)
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    t = ParallelTrainer(_tiny_resnet(), mesh=mesh,
+                        strategy=ShardingStrategy.PIPELINE)
+    assert isinstance(t._pipe, PipelinedGraphTrainer)
+    r = np.random.default_rng(1)
+    x = r.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 8)]
+    t.fit(DataSet(x, y))
+    assert np.isfinite(t.score())
+
+
+def test_pipelined_graph_guards_and_maximize():
+    """Round-3 review regressions: compute_dtype and aux-loss graphs are
+    rejected loudly; invalid user boundaries are rejected; maximize
+    matches single-device."""
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.parallel import make_mesh
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    bf16 = resnet50(n_classes=4, image=16, blocks=(1,), width=8,
+                    compute_dtype="bfloat16").init()
+    with pytest.raises(ValueError, match="compute_dtype"):
+        PipelinedGraphTrainer(bf16, mesh)
+    with pytest.raises(ValueError, match="boundaries"):
+        PipelinedGraphTrainer(_tiny_resnet(), mesh, boundaries=[1_000])
+
+    # maximize graph: pipelined == single-device (sign threading)
+    from deeplearning4j_tpu import NeuralNetConfiguration, OutputLayer
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    def build():
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+             .minimize(False).graph_builder())
+        b.add_inputs("in")
+        b.add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+        b.add_layer("out", OutputLayer(n_out=2, activation="identity",
+                                       loss="mse"), "h")
+        b.set_outputs("out")
+        b.set_input_types(IT.feed_forward(4))
+        return ComputationGraph(b.build()).init()
+
+    r = np.random.default_rng(7)
+    x = r.normal(size=(8, 4)).astype(np.float32)
+    y = r.normal(size=(8, 2)).astype(np.float32)
+    ds = DataSet(x, y)
+    single, piped = build(), build()
+    tr = PipelinedGraphTrainer(piped, mesh, n_microbatches=1)
+    for _ in range(3):
+        single.fit(ds)
+        tr.fit(ds)
+    tr.sync_back()
+    for name in single.params:
+        for k in single.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(piped.params[name][k]),
+                np.asarray(single.params[name][k]), rtol=2e-5, atol=1e-6)

@@ -5,6 +5,7 @@ Mirrors the reference's storage round-trip tests
 end-to-end listener-attach-train-serve pass through the HTTP dashboard.
 """
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -443,5 +444,116 @@ def test_flow_tab_data_and_storage_round_trip(tmp_path):
             urllib.request.urlopen(base + "/train/data.json").read())
         assert [v["name"] for v in data["model"]] == ["input", "layer0",
                                                       "layer1"]
+    finally:
+        server.stop()
+
+
+def test_legacy_remote_iteration_listeners():
+    """WebReporter tier (deeplearning4j-ui-remote-iterationlisteners):
+    direct per-iteration POSTs of flow/histogram payloads to an HTTP
+    endpoint, with queue-on-failure."""
+    import http.server
+    import threading as _t
+
+    from deeplearning4j_tpu.ui.legacy_listeners import (
+        RemoteFlowIterationListener, RemoteHistogramIterationListener,
+        WebReporter)
+
+    received = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    _t.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/legacy"
+    try:
+        model = _small_model()
+        flow_l = RemoteFlowIterationListener(url)
+        hist_l = RemoteHistogramIterationListener(url, frequency=2)
+        model.set_listeners(flow_l, hist_l)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        for _ in range(4):
+            model.fit(DataSet(x, y))
+        # posting is async (worker thread): drain before asserting
+        assert flow_l.reporter.flush() and hist_l.reporter.flush()
+        kinds = [p["type"] for p in received]
+        assert kinds.count("flow") == 4
+        assert kinds.count("histogram") == 2
+        flow = next(p for p in received if p["type"] == "flow")
+        assert [v["name"] for v in flow["model"]] == ["input", "layer0",
+                                                      "layer1"]
+        hist = next(p for p in received if p["type"] == "histogram")
+        assert "layer0/W" in hist["histograms"]
+    finally:
+        srv.shutdown()
+
+    # queue-on-failure: black-holed host keeps payloads pending, and
+    # report() never blocks the caller
+    rep = WebReporter("http://127.0.0.1:1/legacy", timeout=0.2)
+    t0 = time.time()
+    rep.report({"type": "x"})
+    assert time.time() - t0 < 0.1      # non-blocking enqueue
+    assert not rep.flush(timeout=0.5)  # head keeps retrying, stays queued
+    assert rep.pending == 1
+    rep.close()
+
+
+def test_sqlite_stats_storage_round_trip(tmp_path):
+    """SQLite-backed storage (J7FileStatsStorage/MapDBStatsStorage role):
+    durable across connections, same SPI surface + events."""
+    from deeplearning4j_tpu.ui import SqliteStatsStorage
+
+    path = str(tmp_path / "stats.db")
+    storage = SqliteStatsStorage(path)
+    events = []
+    storage.register_listener(events.append)
+    listener = StatsListener(storage, session_id="sq")
+    _train(_small_model(), listener, steps=3)
+    assert storage.list_session_ids() == ["sq"]
+    ups = storage.get_all_updates("sq", StatsListener.TYPE_ID, "local")
+    assert len(ups) == 3 and np.isfinite(ups[-1][1]["score"])
+    kinds = [e.kind for e in events]
+    assert kinds.count(StatsStorageEvent.NEW_SESSION) == 1
+    storage.close()
+
+    reloaded = SqliteStatsStorage(path)     # fresh connection replays
+    rep = reloaded.get_all_updates("sq", StatsListener.TYPE_ID, "local")
+    assert json.dumps(rep) == json.dumps(ups)
+    # serves the dashboard like any storage
+    server = UIServer(port=0).attach(reloaded).start()
+    try:
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/train/data.json").read())
+        assert len(data["scores"]) == 3
+    finally:
+        server.stop()
+        reloaded.close()
+
+
+def test_tsne_tab_and_endpoint():
+    """TsneModule analog: attached 2-D coordinates served at
+    /tsne/data.json and rendered by the t-SNE tab."""
+    server = UIServer(port=0)
+    rng = np.random.default_rng(0)
+    coords = rng.normal(size=(30, 2))
+    labels = [f"c{i % 3}" for i in range(30)]
+    server.attach_tsne(coords, labels).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        html = urllib.request.urlopen(base + "/train").read().decode()
+        assert 'data-p="tsne"' in html
+        data = json.loads(
+            urllib.request.urlopen(base + "/tsne/data.json").read())
+        assert len(data["points"]) == 30 and data["labels"][0] == "c0"
     finally:
         server.stop()
