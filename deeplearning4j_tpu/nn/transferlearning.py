@@ -23,7 +23,7 @@ from .multilayer import MultiLayerNetwork
 from ..datasets.iterators import DataSet
 
 __all__ = ["FineTuneConfiguration", "TransferLearning",
-           "TransferLearningHelper"]
+           "GraphTransferLearning", "TransferLearningHelper"]
 
 
 @dataclass
@@ -249,3 +249,191 @@ class TransferLearningHelper:
             new_params[k + i] = p
         self.model.params = tuple(new_params)
         return self.model
+
+
+class GraphTransferLearning:
+    """`TransferLearning.GraphBuilder` parity
+    (`nn/transferlearning/TransferLearning.java` GraphBuilder inner class):
+    freeze ancestor subgraphs (setFeatureExtractor), nOutReplace on named
+    layers (downstream consumers re-inferred + re-initialized), remove /
+    add vertices, change network outputs — then rebuild with shape
+    inference and transfer every surviving parameter."""
+
+    class GraphBuilder:
+        def __init__(self, graph):
+            if graph.params is None:
+                raise ValueError("Graph must be initialized/trained first")
+            self._graph = graph
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_roots: List[str] = []
+            self._n_out_replacements: Dict[str, tuple] = {}
+            self._removed: List[str] = []
+            self._added: List[tuple] = []     # (name, layer_or_vertex, inputs)
+            self._outputs: Optional[List[str]] = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices and every ancestor (reference
+            setFeatureExtractor: everything up to and including the named
+            vertices becomes a frozen feature extractor)."""
+            self._freeze_roots.extend(vertex_names)
+            return self
+
+        def nout_replace(self, vertex_name: str, n_out: int,
+                         weight_init: Optional[str] = None):
+            self._n_out_replacements[vertex_name] = (int(n_out), weight_init)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            self._removed.append(name)
+            return self
+
+        def add_layer(self, name: str, layer: LayerConf, *inputs: str):
+            self._added.append((name, layer, inputs))
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._added.append((name, vertex, inputs))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        # -- internals -------------------------------------------------
+        def _ancestors(self, conf, roots):
+            out = set()
+            stack = list(roots)
+            while stack:
+                n = stack.pop()
+                if n in out or n not in conf.vertices:
+                    continue
+                out.add(n)
+                stack.extend(i for i in conf.vertex_inputs.get(n, ())
+                             if i in conf.vertices)
+            return out
+
+        def build(self):
+            from .graph import ComputationGraph
+
+            src = self._graph
+            conf = src.conf
+            g_conf = conf.conf
+            if self._fine_tune is not None:
+                g_conf = self._fine_tune.apply_to_global(g_conf)
+
+            removed = set(self._removed)
+            vertices, vertex_inputs = {}, {}
+            shape_changed = []   # vertices whose OUTPUT width may change
+            for n in conf.topological_order:
+                if n not in conf.vertices or n in removed:
+                    continue
+                ins = [i for i in conf.vertex_inputs[n] if i not in removed]
+                if len(ins) != len(conf.vertex_inputs[n]) and not ins:
+                    raise ValueError(
+                        f"removing {sorted(removed)} strands vertex '{n}'")
+                if len(ins) != len(conf.vertex_inputs[n]):
+                    # narrowed a multi-input vertex (e.g. Merge lost a
+                    # branch): its output shape changes downstream
+                    shape_changed.append(n)
+                v = conf.vertices[n]
+                vertices[n] = replace(v) if isinstance(v, LayerConf) else v
+                vertex_inputs[n] = ins
+
+            reinit = set()
+            for name, (n_out, w_init) in self._n_out_replacements.items():
+                if name not in vertices:
+                    raise ValueError(f"nout_replace: no vertex '{name}'")
+                kw = {"n_out": n_out}
+                if w_init:
+                    kw["weight_init"] = w_init
+                vertices[name] = replace(vertices[name], **kw)
+                reinit.add(name)
+                shape_changed.append(name)
+
+            # propagate shape changes FORWARD: a consumer layer re-infers
+            # its n_in (and is re-initialized, stopping propagation — its
+            # n_out is unchanged); non-layer vertices (Merge/ElementWise/
+            # ...) transmit the change to their own consumers
+            frontier = list(shape_changed)
+            seen = set(frontier)
+            while frontier:
+                src_name = frontier.pop()
+                for c, ins in vertex_inputs.items():
+                    if src_name not in ins:
+                        continue
+                    if isinstance(vertices[c], LayerConf):
+                        if hasattr(vertices[c], "n_in"):
+                            vertices[c] = replace(vertices[c], n_in=None)
+                        reinit.add(c)
+                    elif c not in seen:
+                        seen.add(c)
+                        frontier.append(c)
+
+            for name, v, ins in self._added:
+                vertices[name] = v
+                vertex_inputs[name] = list(ins)
+                reinit.add(name)
+
+            frozen = self._ancestors(
+                type("C", (), {"vertices": vertices,
+                               "vertex_inputs": vertex_inputs})(),
+                self._freeze_roots)
+            for n in list(vertices):
+                v = vertices[n]
+                if not isinstance(v, LayerConf):
+                    continue
+                if self._fine_tune is not None and n not in frozen:
+                    vertices[n] = v = self._fine_tune.apply_to_layer(v)
+                if n in frozen:
+                    vertices[n] = replace(v, frozen=True)
+
+            # rebuild with shape inference through the standard builder,
+            # adding vertices in an order where inputs precede consumers
+            from .conf.graph import GraphBuilder as _GB
+            gb = _GB(g_conf)
+            gb.add_inputs(*conf.network_inputs)
+            pending = dict(vertices)
+            placed = set(conf.network_inputs)
+            while pending:
+                progressed = False
+                for n in list(pending):
+                    if all(i in placed for i in vertex_inputs[n]):
+                        v = pending.pop(n)
+                        if isinstance(v, LayerConf):
+                            gb.add_layer(n, v, *vertex_inputs[n])
+                        else:
+                            gb.add_vertex(n, v, *vertex_inputs[n])
+                        placed.add(n)
+                        progressed = True
+                if not progressed:
+                    raise ValueError(
+                        f"cannot order vertices {sorted(pending)} — "
+                        "dangling inputs after edits")
+            outputs = self._outputs or [o for o in conf.network_outputs
+                                        if o in vertices]
+            if not outputs:
+                raise ValueError("no network outputs remain; set_outputs()")
+            gb.set_outputs(*outputs)
+            if conf.input_types:
+                gb.set_input_types(*conf.input_types)
+            new_graph = ComputationGraph(gb.build())
+            new_graph.init()
+            # transfer surviving params, SHAPE-CHECKED: only copy when the
+            # fresh init's shapes match the source exactly (belt and
+            # braces on top of the forward shape propagation above)
+            new_params = dict(new_graph.params)
+            for n, p in src.params.items():
+                if n not in new_params or n in reinit or not p:
+                    continue
+                fresh = new_params[n]
+                if (set(fresh) == set(p)
+                        and all(jax.numpy.shape(fresh[k])
+                                == jax.numpy.shape(p[k]) for k in p)):
+                    new_params[n] = jax.tree_util.tree_map(
+                        lambda a: jax.numpy.array(a, copy=True), p)
+            new_graph.params = new_params
+            return new_graph

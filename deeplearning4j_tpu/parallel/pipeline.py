@@ -167,6 +167,12 @@ class PipelinedNetworkTrainer:
             raise ValueError(f"{self.n_stages} stages > {n_layers} layers")
         if not isinstance(model.layers[-1], BaseOutputLayerConf):
             raise ValueError("last layer must be an output layer")
+        for i, layer in enumerate(model.layers):
+            if getattr(layer, "dropout", None):
+                raise ValueError(
+                    f"layer {i} uses dropout; the pipeline stage functions "
+                    "run without per-step RNG so dropout would be silently "
+                    "disabled — use SYNC/TENSOR_PARALLEL")
         self.boundaries = (list(boundaries) if boundaries is not None
                            else self._balance(n_layers))
         self._setup_devices_and_state()
@@ -480,6 +486,11 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     f"vertex '{n}' carries an auxiliary loss (aux_score) "
                     "which the per-stage pipeline loss does not propagate; "
                     "use SYNC/TENSOR_PARALLEL for MoE graphs")
+            if getattr(conf.vertices[n], "dropout", None):
+                raise ValueError(
+                    f"vertex '{n}' uses dropout; the pipeline stage "
+                    "functions run without per-step RNG so dropout would "
+                    "be silently disabled — use SYNC/TENSOR_PARALLEL")
         cuts = self._clean_cuts()
         if len(cuts) < self.n_stages - 1:
             raise ValueError(

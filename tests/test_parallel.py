@@ -548,3 +548,27 @@ def test_sync_dp_masked_data_matches_single_device():
         trainer.fit(ds)
     np.testing.assert_allclose(multi.params_flat(), single.params_flat(),
                                rtol=2e-5, atol=1e-6)
+
+
+def test_googlenet_merge_dag_sync_dp():
+    """Inception-style multi-branch DAG (MergeVertex) through the trainer:
+    dp == single-device — breadth beyond the ElementWiseVertex ResNet."""
+    from deeplearning4j_tpu.models.zoo import googlenet
+
+    def build():
+        g = googlenet(n_classes=3, image=32, seed=29, updater=Sgd(0.05))
+        return g.init()
+
+    r = np.random.default_rng(2)
+    x = r.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 16)]
+    ds = DataSet(x, y)
+    single, multi = build(), build()
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    for _ in range(2):
+        single.fit(ds)
+        trainer.fit(ds)
+    np.testing.assert_allclose(_graph_params_flat(multi),
+                               _graph_params_flat(single),
+                               rtol=5e-5, atol=1e-5)

@@ -277,3 +277,32 @@ def test_pipelined_graph_guards_and_maximize():
             np.testing.assert_allclose(
                 np.asarray(piped.params[name][k]),
                 np.asarray(single.params[name][k]), rtol=2e-5, atol=1e-6)
+
+
+def test_pipeline_rejects_dropout_models():
+    """Stage functions run without per-step RNG: dropout would silently
+    disable, so both trainers reject it loudly (round-3 review)."""
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel.pipeline import (
+        PipelinedGraphTrainer, PipelinedNetworkTrainer)
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    with pytest.raises(ValueError, match="dropout"):
+        PipelinedNetworkTrainer(MultiLayerNetwork(conf).init(), mesh)
+
+    b = NeuralNetConfiguration.builder().seed(0).graph_builder()
+    b.add_inputs("in")
+    b.add_layer("h", DenseLayer(n_out=8, activation="tanh", dropout=0.5),
+                "in")
+    b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "h")
+    b.set_outputs("out")
+    b.set_input_types(IT.feed_forward(3))
+    with pytest.raises(ValueError, match="dropout"):
+        PipelinedGraphTrainer(ComputationGraph(b.build()).init(), mesh)

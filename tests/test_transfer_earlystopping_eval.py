@@ -287,3 +287,131 @@ def test_eval_meta_data_time_series_expansion():
     assert len(ev.predictions) == 5          # 2 + 3 unmasked timesteps
     errs = ev.get_prediction_errors()
     assert [p.meta for p in errs] == ["a", "b"]   # t1 of a, t2 of b
+
+
+def test_graph_transfer_learning_builder():
+    """TransferLearning.GraphBuilder parity: freeze ancestor subgraph,
+    nOutReplace on a named layer, swap the output head — params transfer
+    for surviving vertices, frozen vertices don't move during fit."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (DataSet, DenseLayer,
+                                    NeuralNetConfiguration, OutputLayer, Sgd)
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, GraphTransferLearning)
+
+    b = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("h1", DenseLayer(n_out=12, activation="tanh"), "in")
+    b.add_layer("h2", DenseLayer(n_out=8, activation="tanh"), "h1")
+    b.add_layer("out", OutputLayer(n_out=5, loss="mcxent"), "h2")
+    b.set_outputs("out")
+    b.set_input_types(IT.feed_forward(6))
+    src = ComputationGraph(b.build()).init()
+    h1_w = np.asarray(src.params["h1"]["W"]).copy()
+
+    new = (GraphTransferLearning.GraphBuilder(src)
+           .fine_tune_configuration(
+               FineTuneConfiguration.Builder().updater(Sgd(0.05)).build())
+           .set_feature_extractor("h1")
+           .nout_replace("h2", 10)
+           .remove_vertex_and_connections("out")
+           .add_layer("new_out", OutputLayer(n_out=3, loss="mcxent"), "h2")
+           .set_outputs("new_out")
+           .build())
+    # transferred: h1 weights identical; h2 re-initialized at new width
+    np.testing.assert_array_equal(np.asarray(new.params["h1"]["W"]), h1_w)
+    assert new.params["h2"]["W"].shape == (12, 10)
+    assert new.params["new_out"]["W"].shape == (10, 3)
+    assert new.conf.vertices["h1"].frozen
+    assert not new.conf.vertices["h2"].frozen
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 16)]
+    ds = DataSet(x, y)
+    for _ in range(3):
+        new.fit(ds)
+    np.testing.assert_array_equal(np.asarray(new.params["h1"]["W"]), h1_w)
+    assert np.isfinite(new.score())
+
+
+def test_graph_transfer_learning_freezes_dag_ancestors():
+    """Freezing a merge vertex freezes BOTH branches upstream."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (DenseLayer, NeuralNetConfiguration,
+                                    OutputLayer, Sgd)
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import GraphTransferLearning
+
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("a", DenseLayer(n_out=4, activation="relu"), "in")
+    b.add_layer("bb", DenseLayer(n_out=4, activation="tanh"), "in")
+    b.add_vertex("m", MergeVertex(), "a", "bb")
+    b.add_layer("top", DenseLayer(n_out=6, activation="relu"), "m")
+    b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "top")
+    b.set_outputs("out")
+    b.set_input_types(IT.feed_forward(3))
+    src = ComputationGraph(b.build()).init()
+
+    new = (GraphTransferLearning.GraphBuilder(src)
+           .set_feature_extractor("m")
+           .build())
+    assert new.conf.vertices["a"].frozen and new.conf.vertices["bb"].frozen
+    assert not new.conf.vertices["top"].frozen
+    # params transferred wholesale
+    np.testing.assert_array_equal(np.asarray(new.params["top"]["W"]),
+                                  np.asarray(src.params["top"]["W"]))
+
+
+def test_graph_transfer_shape_propagation_through_merge():
+    """Round-3 review regressions: nout_replace / branch removal must
+    propagate shapes THROUGH non-layer vertices (MergeVertex) so
+    downstream layers re-infer n_in and get fresh params."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (DataSet, DenseLayer,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    Sgd)
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import GraphTransferLearning
+
+    def build():
+        b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+             .graph_builder())
+        b.add_inputs("in")
+        b.add_layer("a", DenseLayer(n_out=4, activation="relu"), "in")
+        b.add_layer("bb", DenseLayer(n_out=4, activation="tanh"), "in")
+        b.add_vertex("m", MergeVertex(), "a", "bb")
+        b.add_layer("top", DenseLayer(n_out=6, activation="relu"), "m")
+        b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "top")
+        b.set_outputs("out")
+        b.set_input_types(IT.feed_forward(3))
+        return ComputationGraph(b.build()).init()
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[r.integers(0, 2, 8)]
+    ds = DataSet(x, y)
+
+    g1 = (GraphTransferLearning.GraphBuilder(build())
+          .nout_replace("a", 8).build())
+    assert g1.params["top"]["W"].shape == (12, 6)
+    g1.fit(ds)
+    assert np.isfinite(g1.score())
+
+    g2 = (GraphTransferLearning.GraphBuilder(build())
+          .remove_vertex_and_connections("a").build())
+    assert g2.params["top"]["W"].shape == (4, 6)
+    g2.fit(ds)
+    assert np.isfinite(g2.score())
