@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# CI loop (reference repo-root `runtests.sh`): run the suite on the
+# 8-device virtual CPU mesh, optionally in a loop to shake out flakes.
+#   ./runtests.sh            one pass
+#   ./runtests.sh 5          five consecutive passes (stop on first failure)
+set -euo pipefail
+cd "$(dirname "$0")"
+runs="${1:-1}"
+for i in $(seq 1 "$runs"); do
+    echo "=== test pass $i/$runs ==="
+    python -m pytest tests/ -q
+done
