@@ -39,24 +39,39 @@ def bench_word2vec(n_sentences=100000, sent_len=20, vocab=10000, epochs=1,
                    layer_size=128, window_size=5, negative=5,
                    min_word_frequency=1, epochs=epochs,
                    batch_size=batch_words, seed=7)
+    import jax.numpy as jnp
+
+    def sync():
+        # real device barrier: the SGNS epochs dispatch asynchronously, so
+        # wall time without a sync measures the host pipeline only
+        # (block_until_ready can no-op on remote-attach backends; a host
+        # materialization cannot)
+        float(jnp.asarray(w2v.lookup_table.syn0).sum())
+
     total_words = n_sentences * sent_len * epochs
     t0 = time.perf_counter()
     w2v.fit()
+    sync()
     cold = total_words / (time.perf_counter() - t0)
-    # steady-state: the epoch runner + corpus are cached -> measures the
-    # per-epoch device + host pipeline without compile
+    # steady-state: epoch runner + flattened corpus are cached -> measures
+    # the device SGNS epoch itself (the host tokenize/flatten is paid once,
+    # exactly as an epochs=N fit pays it)
     t0 = time.perf_counter()
     w2v.fit()
+    sync()
     warm = total_words / (time.perf_counter() - t0)
     return cold, warm
 
 
 def bench_scaling(devices=8):
-    """Strong-scaling efficiency of the DECLARED config (VGG16, fixed global
-    batch) on the virtual CPU mesh, in a subprocess so the parent's
-    TPU-initialized jax doesn't pin the platform. CPU-feasible sizes
-    (image 32, batch 32); the full phase + updater-ablation run is recorded
-    in BASELINE.md row 5."""
+    """Strong-scaling efficiency of the DECLARED config (VGG16, image 32,
+    fixed global batch 32, 10 measured steps, Adam + SGD updater ablation)
+    on the virtual CPU mesh, in a subprocess so the parent's
+    TPU-initialized jax doesn't pin the platform. This is the SAME
+    invocation BASELINE.md row 5 documents — the two artifacts cannot
+    drift. The SGD number is an efficiency LOWER BOUND: on the virtual
+    mesh all 8 "devices" contend for the same host cores, so compute
+    replication inflates t8 beyond genuine collective overhead."""
     from deeplearning4j_tpu.util.platform import (
         child_env_with_virtual_devices)
 
@@ -64,9 +79,9 @@ def bench_scaling(devices=8):
     out = subprocess.run(
         [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
          "--devices", str(devices), "--model", "vgg16",
-         "--global-batch", "32", "--steps", "2", "--no-ablation"],
+         "--global-batch", "32", "--steps", "10"],
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-        capture_output=True, text=True, timeout=900)
+        capture_output=True, text=True, timeout=1800)
     if out.returncode != 0:
         return None
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -79,6 +94,9 @@ def main():
     from deeplearning4j_tpu.models.zoo import (bench_char_rnn, bench_lenet,
                                                bench_resnet50)
 
+    from deeplearning4j_tpu.models.zoo import (bench_char_rnn_dispatch,
+                                               bench_lenet_dispatch)
+
     extras = {}
     lenet_sps, _ = bench_lenet()
     extras["LeNet-MNIST"] = round(lenet_sps, 1)
@@ -86,6 +104,12 @@ def main():
     extras["ResNet50-ImageNet"] = round(resnet_sps, 1)
     rnn_tps, _ = bench_char_rnn()
     extras["charRNN-tokens"] = round(rnn_tps, 1)
+    # per-batch fit() dispatch path (the reference's actual usage pattern)
+    # tracked alongside the device-resident scan fast path
+    lenet_d, _ = bench_lenet_dispatch()
+    extras["LeNet-MNIST-dispatch"] = round(lenet_d, 1)
+    rnn_d, _ = bench_char_rnn_dispatch()
+    extras["charRNN-tokens-dispatch"] = round(rnn_d, 1)
     try:
         w2v_cold, w2v_warm = bench_word2vec()
         extras["Word2Vec-SGNS-words"] = round(w2v_cold, 1)
@@ -96,6 +120,11 @@ def main():
         sc = bench_scaling(8)
         if sc:
             extras["DP-strong-scaling-8dev"] = sc["efficiency"]
+            ab = sc.get("updater_ablation") or {}
+            if "efficiency_sgd" in ab:
+                # lower bound on efficiency: virtual-mesh compute
+                # contention inflates t8 (see bench_scaling docstring)
+                extras["DP-strong-scaling-8dev-sgd"] = ab["efficiency_sgd"]
     except Exception:
         pass
 

@@ -3,10 +3,16 @@ from .iterators import (
     ArrayDataSetIterator, AsyncDataSetIterator, MultipleEpochsIterator,
     SamplingDataSetIterator, IteratorDataSetIterator, ExistingDataSetIterator,
 )
+from .export import (
+    export_datasets, export_sharded, load_dataset, PathDataSetIterator,
+    ShardedPathDataSetIterator, LocalShardDataSet,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "IteratorDataSetIterator",
     "ExistingDataSetIterator",
+    "export_datasets", "export_sharded", "load_dataset",
+    "PathDataSetIterator", "ShardedPathDataSetIterator", "LocalShardDataSet",
 ]

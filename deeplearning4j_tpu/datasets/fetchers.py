@@ -34,6 +34,7 @@ __all__ = [
     "data_dir", "read_idx", "MnistDataFetcher", "IrisDataFetcher",
     "CifarDataFetcher", "LFWDataFetcher", "CurvesDataFetcher",
     "IRIS_FEATURES", "IRIS_LABELS", "bundled_mnist_subset",
+    "bundled_mnist_stratified", "augment_digits",
 ]
 
 
@@ -46,17 +47,82 @@ def bundled_mnist_subset(train_count: int = 320, seed: int = 0):
 
     Returns (x_train [N,784] f32 in [0,1], y_train one-hot, x_test, y_test)
     with a deterministic shuffled split."""
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "resources", "mnist_subset.npz")
-    with np.load(path) as z:
-        x = z["images"].astype(np.float32) / 255.0
-        y = z["labels"].astype(np.int64)
+    imgs, labels = _bundled_mnist_raw()
+    x = imgs.astype(np.float32) / 255.0
+    y = labels
     rng = np.random.default_rng(seed)
     order = rng.permutation(len(x))
     x, y = x[order].reshape(len(x), -1), y[order]
     oh = np.eye(10, dtype=np.float32)[y]
     return (x[:train_count], oh[:train_count],
             x[train_count:], oh[train_count:])
+
+def _bundled_mnist_raw():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "resources", "mnist_subset.npz")
+    with np.load(path) as z:
+        return z["images"].astype(np.uint8), z["labels"].astype(np.int64)
+
+
+def bundled_mnist_stratified(test_per_class: int = 4, seed: int = 0):
+    """Stratified split of the bundled 384 real digits: `test_per_class`
+    held-out digits per class (balanced eval set), the rest train.
+    Returns (train_images [N,28,28] u8, train_labels, test_images,
+    test_labels) — raw pixels, for use with `augment_digits`."""
+    imgs, labels = _bundled_mnist_raw()
+    rng = np.random.default_rng(seed)
+    te = []
+    for c in range(10):
+        idx = np.where(labels == c)[0]
+        te.extend(rng.permutation(idx)[:test_per_class])
+    te = np.array(sorted(te))
+    tr = np.setdiff1d(np.arange(len(imgs)), te)
+    return imgs[tr], labels[tr], imgs[te], labels[te]
+
+
+def augment_digits(images, labels, n_aug: int = 7, seed: int = 0):
+    """Label-preserving MNIST augmentation: small rotation, affine
+    shear/zoom/shift, and elastic deformation (Simard 2003 — the classic
+    MNIST recipe). Stretches the offline real-digit budget (384 bundled
+    digits, zero-egress environment) into a training set large enough for
+    the >=97% convergence gate; evaluation stays on untouched real
+    pixels. Returns ([N*(1+n_aug), 784] f32 in [0,1], one-hot labels)."""
+    from scipy import ndimage
+
+    rng = np.random.default_rng(seed)
+
+    def elastic(img, alpha=6.0, sigma=3.5):
+        dx = ndimage.gaussian_filter(rng.uniform(-1, 1, (28, 28)), sigma) * alpha
+        dy = ndimage.gaussian_filter(rng.uniform(-1, 1, (28, 28)), sigma) * alpha
+        yy, xx = np.meshgrid(np.arange(28), np.arange(28), indexing="ij")
+        return ndimage.map_coordinates(img, [yy + dy, xx + dx],
+                                       order=1).reshape(28, 28)
+
+    def one(img):
+        out = img.astype(np.float32)
+        out = ndimage.rotate(out, rng.uniform(-12, 12), reshape=False,
+                             order=1)
+        sh = rng.uniform(-0.08, 0.08, 2)
+        zm = rng.uniform(0.9, 1.1)
+        mat = np.array([[zm, sh[0]], [sh[1], zm]])
+        c = 13.5
+        off = c - mat @ np.array([c, c]) + rng.uniform(-2, 2, 2)
+        out = ndimage.affine_transform(out, mat, offset=off, order=1)
+        if rng.random() < 0.7:
+            out = elastic(out)
+        return np.clip(out, 0, 255)
+
+    xs, ys = [], []
+    for img, lab in zip(images, labels):
+        xs.append(img.astype(np.float32))
+        ys.append(lab)
+        for _ in range(n_aug):
+            xs.append(one(img))
+            ys.append(lab)
+    x = (np.stack(xs) / 255.0).reshape(len(xs), -1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[np.array(ys)]
+    return x, y
+
 
 _MNIST_URLS = [
     "https://storage.googleapis.com/cvdf-datasets/mnist/",

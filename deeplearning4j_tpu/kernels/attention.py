@@ -58,8 +58,12 @@ def _round_up(n: int, m: int) -> int:
 
 
 def _make_kernel(causal: bool, sm_scale: float, bq: int, bk: int,
-                 s_len: int):
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
+                 s_len: int, emit_lse: bool = True):
+    def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
+        if emit_lse:
+            lse_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            m_ref, l_ref, acc_ref = rest
         i = pl.program_id(1)
         j = pl.program_id(2)
 
@@ -108,13 +112,19 @@ def _make_kernel(causal: bool, sm_scale: float, bq: int, bk: int,
             o_ref[0] = (acc_ref[:]
                         / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
                             o_ref.dtype)
-            m_safe = jnp.where(jnp.isneginf(m_ref[:]), 0.0, m_ref[:])
-            lse_ref[0] = m_safe + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+            if emit_lse:
+                m_safe = jnp.where(jnp.isneginf(m_ref[:]), 0.0, m_ref[:])
+                lse_ref[0] = m_safe + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
     return kernel
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                    emit_lse: bool = True):
+    """emit_lse=False (the primal/inference path) skips computing AND
+    writing the lane-replicated [B, Tp, 128] f32 logsumexp output — that
+    write is up to 2x the HBM output traffic of a bf16 D=128 out row, and
+    only the fwd-for-vjp path needs it."""
     B, T, D = q.shape
     S = k.shape[1]
     bq = min(block_q, _round_up(T, 8))
@@ -124,11 +134,19 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     kp = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0)))
     grid = (B, Tp // bq, Sp // bk)
-    kernel = _make_kernel(causal, sm_scale, bq, bk, S)
-    out, lse = pl.pallas_call(
+    kernel = _make_kernel(causal, sm_scale, bq, bk, S, emit_lse)
+    o_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    out_shape = (jax.ShapeDtypeStruct((B, Tp, D), q.dtype),)
+    out_specs = (o_spec,)
+    if emit_lse:
+        out_shape += (jax.ShapeDtypeStruct((B, Tp, 128), jnp.float32),)
+        out_specs += (lse_spec,)
+    res = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((B, Tp, D), q.dtype),
-                   jax.ShapeDtypeStruct((B, Tp, 128), jnp.float32)),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
@@ -138,10 +156,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=(pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0),
-                                memory_space=pltpu.VMEM)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # running max m
             pltpu.VMEM((bq, 128), jnp.float32),   # running denom l
@@ -149,6 +164,9 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
+    if not emit_lse:
+        return res[0][:, :T], None
+    out, lse = res
     # keep only one lane of the lane-replicated LSE: the residual held from
     # forward to backward is [B, Tp], not [B, Tp, 128]
     return out[:, :T], lse[:, :, 0]
@@ -304,7 +322,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, sm_scale, block_q, block_k,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     out, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                             interpret)
+                             interpret, emit_lse=False)
     return out
 
 

@@ -66,13 +66,15 @@ def _normalize(x, mean, var, gamma, beta, eps, act):
     return y.astype(x.dtype), inv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_bn_act(x, gamma, beta, eps: float, act: str):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_act(x, gamma, beta, eps: float, act: str,
+                 store_dtype: str = ""):
     """Training-mode BN + activation over channels-last `x` (any rank >= 2;
     stats over all axes but the last). Returns (y, batch_mean, batch_var);
     the stats are stop-gradient (running-average semantics, as the
     reference's BatchNormalization treats them). `act` must be in
-    FUSED_BN_ACTIVATIONS."""
+    FUSED_BN_ACTIVATIONS. `store_dtype` (e.g. "float8_e4m3fn") stores the
+    saved-for-backward x compactly — an HBM traffic/precision trade."""
     y, mean, var, _ = _fwd_math(x, gamma, beta, eps, act)
     return y, mean, var
 
@@ -85,12 +87,15 @@ def _fwd_math(x, gamma, beta, eps, act):
     return y, mean, var, (x, mean, inv, n)
 
 
-def _fwd(x, gamma, beta, eps, act):
+def _fwd(x, gamma, beta, eps, act, store_dtype):
     y, mean, var, res = _fwd_math(x, gamma, beta, eps, act)
+    if store_dtype:
+        x_saved, rest = res[0], res[1:]
+        res = (x_saved.astype(jnp.dtype(store_dtype)),) + rest
     return (y, mean, var), res + (gamma, beta)
 
 
-def _bwd(eps, act, res, cotangents):
+def _bwd(eps, act, store_dtype, res, cotangents):
     x, mean, inv, n, gamma, beta = res
     dy, _dmean, _dvar = cotangents  # stats are stop-gradient
     axes = tuple(range(x.ndim - 1))
@@ -104,7 +109,9 @@ def _bwd(eps, act, res, cotangents):
         dyf = jnp.where(mask, dyf, 0.0)
     dg = jnp.sum(dyf * xhat, axis=axes)
     db = jnp.sum(dyf, axis=axes)
-    dx = ((gf * inv) * (dyf - (db + xhat * dg) / n)).astype(x.dtype)
+    # dx in the ORIGINAL activation dtype (dy carries it — x may be stored
+    # compactly via store_dtype)
+    dx = ((gf * inv) * (dyf - (db + xhat * dg) / n)).astype(dy.dtype)
     return dx, dg.astype(gamma.dtype), db.astype(beta.dtype)
 
 

@@ -71,7 +71,16 @@ def _collect_weight_pairs(h5file) -> List[Tuple[np.ndarray, np.ndarray]]:
             if attr in attrs:
                 names = [n.decode() if isinstance(n, bytes) else str(n)
                          for n in attrs[attr]]
-                keys = [n for n in names if n in group]
+                missing = [n for n in names if n not in group]
+                if missing:
+                    # a truncated/renamed weights file would otherwise
+                    # silently shift the remaining pairs onto wrong layers
+                    raise ValueError(
+                        f"HDF5 {attr} attr under "
+                        f"{getattr(group, 'name', '/')!r} lists entries "
+                        f"missing from the group: {missing[:5]} — the "
+                        "weights file is truncated or renamed")
+                keys = names
                 break
         if keys is None:
             keys = list(group)

@@ -112,7 +112,9 @@ def bench_char_rnn(batch: int = 64, seq_len: int = 128, steps: int = 240,
 
 def resnet50(n_classes: int = 1000, image: int = 224, seed: int = 42,
              updater=None, blocks=(3, 4, 6, 3), width: int = 64,
-             compute_dtype: str | None = "bfloat16"):
+             compute_dtype: str | None = "bfloat16",
+             remat: str | None = None,
+             activation_store_dtype: str | None = None):
     """ResNet-50 as a ComputationGraph (BASELINE config #2): bottleneck
     residual blocks via ElementWiseVertex(add) — the reference expresses
     ResNet the same way with its vertex API. NHWC, bottleneck 1-3-1 convs,
@@ -128,6 +130,8 @@ def resnet50(n_classes: int = 1000, image: int = 224, seed: int = 42,
          .updater(updater or Adam(1e-3))
          .weight_init("relu")
          .compute_dtype(compute_dtype)
+         .remat(remat)
+         .activation_store_dtype(activation_store_dtype)
          .graph_builder()
          .add_inputs("input")
          .set_input_types(InputType.convolutional(image, image, 3)))
@@ -179,22 +183,29 @@ def resnet50(n_classes: int = 1000, image: int = 224, seed: int = 42,
     return ComputationGraph(b.build())
 
 
-def bench_resnet50(batch: int = 256, steps: int = 20,
+def bench_resnet50(batch: int = 256, steps: int = 30,
                    image: int = 224, n_classes: int = 1000,
                    compute_dtype: str | None = "bfloat16"):
     """samples/sec for ResNet-50 ImageNet-shaped training (BASELINE #2):
     the [steps]-pass runs as one device-resident `fit_scan_arrays`
     dispatch, so the number measures the training step, not the host link
     or per-step dispatch. Warmup = one full same-length scan (the epoch fn
-    specializes on T)."""
+    specializes on T). Round-4 ablation winners applied (see BASELINE.md
+    ablation table): Adam m/v stored bf16, bf16 input window (the model
+    casts inputs to the compute dtype at entry anyway — pre-casting halves
+    the scanned window's HBM read), 30-step window (tunnel round trip
+    amortizes to ~3%)."""
     import jax
     import jax.numpy as jnp
 
     model = resnet50(image=image, n_classes=n_classes,
-                     compute_dtype=compute_dtype).init()
+                     compute_dtype=compute_dtype,
+                     updater=Adam(1e-3, state_dtype="bfloat16")).init()
     r = np.random.default_rng(0)
     x = r.normal(size=(batch, image, image, 3)).astype(np.float32)
     y = np.eye(n_classes, dtype=np.float32)[r.integers(0, n_classes, batch)]
+    if compute_dtype is not None:
+        x = x.astype(jnp.dtype(compute_dtype))
     # device-resident [T,...] batches: transfer ONE batch over the link and
     # broadcast on device; the whole [steps]-pass runs as one scan dispatch
     # (same device-resident policy as the LeNet/charRNN benches)
@@ -266,6 +277,54 @@ def bench_lenet(batch: int = 512, steps: int = 800, warmup: int = 5):
     float(model.score())
     dt = time.perf_counter() - t0
     return batch * steps / dt, "LeNet-MNIST"
+
+
+def bench_lenet_dispatch(batch: int = 512, steps: int = 300, warmup: int = 20):
+    """samples/sec for LeNet through the PER-BATCH fit() path (one jitted
+    step dispatch per batch — the reference's actual usage pattern,
+    `MultiLayerNetwork.fit(DataSetIterator)`). Complements the
+    device-resident fit_scan number: together they track both the
+    dispatch path and the scan fast path (BASELINE row 1)."""
+    from ..datasets.iterators import DataSet
+
+    model = lenet_mnist().init()
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, batch)]
+    ds = DataSet(x, y)   # device_tuple cache: transfer paid once
+    for _ in range(warmup):
+        model.fit(ds)
+    float(model.score())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    float(model.score())
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "LeNet-MNIST-dispatch"
+
+
+def bench_char_rnn_dispatch(batch: int = 64, seq_len: int = 128,
+                            steps: int = 150, warmup: int = 10,
+                            vocab: int = 77):
+    """tokens/sec for char-RNN through the per-batch fit() path (TBPTT
+    chunking included) — the dispatch-path complement of bench_char_rnn."""
+    from ..datasets.iterators import DataSet
+
+    model = char_rnn(vocab_size=vocab, seq_len=seq_len, tbptt=64).init()
+    r = np.random.default_rng(0)
+    idx = r.integers(0, vocab, (batch, seq_len))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        model.fit(ds)
+    float(model.score())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds)
+    float(model.score())
+    dt = time.perf_counter() - t0
+    return batch * seq_len * steps / dt, "charRNN-tokens-dispatch"
 
 
 def alexnet(n_classes: int = 1000, image: int = 224, seed: int = 42,

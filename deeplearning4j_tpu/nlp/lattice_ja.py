@@ -44,8 +44,10 @@ BOS = "^"
 EOS = "$"
 
 # connection costs between coarse classes: row = left (previous word's
-# class), col = right (next word's class). Negative = favored transition.
-# Scale is arbitrary; only relative order matters for the argmin path.
+# class), col = right (next word's class). NON-NEGATIVE (0 = canonical
+# bigram, larger = disfavored): negative "bonuses" would reward paths for
+# taking MORE transitions — the same cost inversion that broke negative
+# word costs (see _LEX_SRC note). Scale matches the word costs (~5-120).
 _CONN: Dict[Tuple[str, str], int] = {}
 
 
@@ -63,27 +65,28 @@ _set(BOS, PART, 90)      # sentences rarely start with a particle
 _set(BOS, AUX, 80)
 _set(BOS, SUF, 90)
 for _left in (NOUN, UNK, SUF):
-    _set(_left, PART, -30)   # noun -> particle: the canonical bigram
-    _set(_left, AUX, -5)     # noun -> copula (です/だ)
-    _set(_left, SUF, -10)    # noun -> suffix (さん/たち/語)
-    _set(_left, NOUN, 15)    # compound nouns exist but are dispreferred
-    _set(_left, VERB, 5)
+    _set(_left, PART, 0)     # noun -> particle: the canonical bigram
+    _set(_left, AUX, 5)      # noun -> copula (です/だ)
+    _set(_left, SUF, 5)      # noun -> suffix (さん/たち/語)
+    _set(_left, NOUN, 25)    # compound nouns exist but are dispreferred
+    _set(_left, VERB, 15)
 for _x in (NOUN, VERB, ADJ, ADV, UNK):
-    _set(PART, _x, -10)      # particle -> content word
-_set(PART, PART, 80)         # には/では are their own entries — chains of
-_set(PART, AUX, 70)          # bare particles are almost always missegmented
+    _set(PART, _x, 0)        # particle -> content word
+_set(PART, PART, 60)         # には/では are their own entries — chains of
+_set(PART, AUX, 50)          # bare particles are almost always missegmented
                              # kana words (IPADIC encodes this in its ids)
-_set(VERB, AUX, -40)         # verb stem -> ます/ました/たい
-_set(VERB, VERB, 10)         # compound verbs / te-form chains
-_set(VERB, PART, 0)          # 行くのは / 食べてから
+_set(VERB, AUX, 0)           # verb stem -> ます/ました/たい
+_set(VERB, VERB, 15)         # compound verbs / te-form chains
+_set(VERB, PART, 5)          # 行くのは / 食べてから
 _set(VERB, NOUN, 25)
-_set(AUX, AUX, -15)          # まし+た / てい+ます chains
-_set(AUX, EOS, -30)
-_set(AUX, PART, 15)          # ですか/ですね (sentence-final particles)
-_set(ADJ, NOUN, -10)         # adjective -> noun
-_set(ADJ, AUX, -10)          # 大きいです
-_set(ADV, VERB, -10)
-for _left in (NOUN, VERB, AUX, UNK, SUF, PART):
+_set(AUX, AUX, 0)            # まし+た / てい+ます chains
+_set(AUX, EOS, 0)
+_set(AUX, PART, 10)          # ですか/ですね (sentence-final particles)
+_set(AUX, NOUN, 25)
+_set(ADJ, NOUN, 0)           # adjective -> noun
+_set(ADJ, AUX, 0)            # 大きいです
+_set(ADV, VERB, 0)
+for _left in (NOUN, VERB, AUX, UNK, SUF, PART, ADJ, ADV):
     _CONN.setdefault((_left, EOS), 0)
 
 
@@ -96,81 +99,120 @@ def _entries(cls: str, cost: int, words: str) -> List[Tuple[str, int, str]]:
     return [(w, cost, cls) for w in words.split()]
 
 
+# Word costs are POSITIVE (the IPADIC convention): every edge adds cost,
+# so fewer/longer words win by default and strong (frequent) words earn
+# low costs. (Round-3's negative costs inverted this — once the lexicon
+# grew past closed-class size, Viterbi exploded text into chains of
+# single-char "particles" because more edges meant more negative total.)
 _LEX_SRC: List[Tuple[str, int, str]] = []
 # particles (case markers, topic, conjunctive)
-_LEX_SRC += _entries(PART, -60, "は が を に で と へ も の や か ね よ "
-                                "わ ぞ さ から まで より こそ しか でも "
-                                "など って ば たり し のに ので けど "
-                                "けれど ながら には では とは への")
-# copula / polite auxiliaries / verbal endings
-_LEX_SRC += _entries(AUX, -55, "です だ でした だった ます ました ません "
-                               "ませ ない なかった たい たく て で た "
-                               "いる いた います いました ある あります "
-                               "ありました れる られる せる させる う よう "
-                               "だろう でしょう そうだ ようだ らしい")
+_LEX_SRC += _entries(PART, 8, "は が を に で と へ も の や か ね よ "
+                              "わ ぞ さ から まで より こそ しか でも "
+                              "など って ば たり し のに ので けど "
+                              "けれど ながら には では とは への")
+# copula / polite auxiliaries / verbal endings — IPADIC token units only:
+# the lattice composes ました as まし+た, でした as でし+た etc. (curated
+# conjugated compounds would contradict the gold segmentation the F1 test
+# measures against)
+_LEX_SRC += _entries(AUX, 10, "です だ でし だっ ます まし ませ ん "
+                              "ない なかっ たい たく て で た "
+                              "いる い れる られる せる させる う よう "
+                              "だろ でしょ らしい")
 # demonstratives & pronouns
-_LEX_SRC += _entries(NOUN, -40, "これ それ あれ どれ ここ そこ あそこ どこ "
-                                "この その あの どの こちら そちら だれ 誰 "
-                                "何 なに 私 僕 俺 君 彼 彼女 あなた 皆 "
-                                "みんな 自分")
+_LEX_SRC += _entries(NOUN, 25, "これ それ あれ どれ ここ そこ あそこ どこ "
+                               "この その あの どの こちら そちら だれ 誰 "
+                               "何 なに 私 僕 俺 君 彼 彼女 あなた 皆 "
+                               "みんな 自分")
 # very frequent nouns
-_LEX_SRC += _entries(NOUN, -25, "人 日 時 年 月 今日 明日 昨日 今 時間 "
-                                "学生 先生 学校 大学 会社 仕事 日本 日本語 "
-                                "英語 東京 京都 国 家 水 本 車 電車 駅 道 "
-                                "店 朝 昼 夜 天気 雨 映画 音楽 犬 猫 友達 "
-                                "家族 母 父 子供 名前 話 気 手 目 心 上 下 "
-                                "中 外 前 後 こと もの ところ ため")
-# frequent verbs (dictionary + common conjugated surfaces)
-_LEX_SRC += _entries(VERB, -30, "する します した して しません しよう "
-                                "行く 行き 行きます 行った 行って 来る 来ます "
-                                "来た 来て 食べる 食べ 食べます 食べた 食べて "
-                                "飲む 飲み 飲みます 飲んだ 飲んで 見る 見ます "
-                                "見た 見て 聞く 聞き 聞いた 聞いて 読む 読み "
-                                "読みます 読んだ 読んで 書く 書き 書きます "
-                                "書いた 書いて 話す 話し 話します 話した "
-                                "話して 思う 思い 思います 思った 言う 言い "
-                                "言った 言って 使う 使い 使った 持つ 持ち "
-                                "持った 持って 作る 作り 作った 作って 分かる "
-                                "分かり 分かります 分かった なる なり なります "
-                                "なった なって 買う 買い 買った 買って 勉強 "
-                                "働く 働き 働いて 住む 住んで 会う 会い 会って")
+_LEX_SRC += _entries(NOUN, 40, "人 日 時 年 月 今日 明日 昨日 今 時間 "
+                               "学生 先生 学校 大学 会社 仕事 日本 日本語 "
+                               "英語 東京 京都 国 家 水 本 車 電車 駅 道 "
+                               "店 朝 昼 夜 天気 雨 映画 音楽 犬 猫 友達 "
+                               "家族 母 父 子供 名前 話 気 手 目 心 上 下 "
+                               "中 外 前 後 こと もの ところ ため")
+# frequent verbs — dictionary forms, continuative stems, and 音便 stems
+# (IPADIC units: 行った is 行っ + た, 読んで is 読ん + で)
+_LEX_SRC += _entries(VERB, 35, "する し 行く 行き 行っ 来る 来 "
+                               "食べる 食べ 飲む 飲み 飲ん 見る 見 "
+                               "聞く 聞き 聞い 読む 読み 読ん "
+                               "書く 書き 書い 話す 話し "
+                               "思う 思い 思っ 言う 言い 言っ "
+                               "使う 使い 使っ 持つ 持ち 持っ "
+                               "作る 作り 作っ 分かる 分かり 分かっ "
+                               "なる なり なっ 買う 買い 買っ 勉強 "
+                               "働く 働き 働い 住む 住ん 会う 会い 会っ")
 # adjectives
-_LEX_SRC += _entries(ADJ, -25, "大きい 小さい 新しい 古い いい 良い 悪い "
-                               "高い 安い 長い 短い 暑い 寒い 早い 遅い "
-                               "多い 少ない 面白い 楽しい 難しい 簡単 綺麗 "
-                               "きれい 元気 好き 嫌い 上手 下手 おいしい "
-                               "美味しい")
+_LEX_SRC += _entries(ADJ, 40, "大きい 小さい 新しい 古い いい 良い 悪い "
+                              "高い 安い 長い 短い 暑い 寒い 早い 遅い "
+                              "多い 少ない 面白い 楽しい 難しい 簡単 綺麗 "
+                              "きれい 元気 好き 嫌い 上手 下手 おいしい "
+                              "美味しい")
 # adverbs / conjunctions
-_LEX_SRC += _entries(ADV, -25, "とても すこし 少し もう まだ また いつも "
-                               "時々 たくさん ちょっと そして でも しかし "
-                               "だから では はい いいえ")
+_LEX_SRC += _entries(ADV, 40, "とても すこし 少し もう まだ また いつも "
+                              "時々 たくさん ちょっと そして でも しかし "
+                              "だから では はい いいえ")
 # suffixes
-_LEX_SRC += _entries(SUF, -35, "さん ちゃん 君 様 たち 達 語 人 中 的 年 "
-                               "月 日 時 分 円 歳")
+_LEX_SRC += _entries(SUF, 30, "さん ちゃん 君 様 たち 達 語 人 中 的 年 "
+                              "月 日 時 分 円 歳")
 
 # frequent proper nouns (surnames/places — IPADIC's proper-noun entries;
 # without them 田中 loses to 田+中(suffix))
-_LEX_SRC += _entries(NOUN, -30, "田中 山田 鈴木 佐藤 高橋 伊藤 渡辺 中村 "
-                                "小林 加藤 大阪 名古屋 横浜 北海道 九州 "
-                                "沖縄 富士山 アメリカ 中国 韓国 フランス")
+_LEX_SRC += _entries(NOUN, 35, "田中 山田 鈴木 佐藤 高橋 伊藤 渡辺 中村 "
+                               "小林 加藤 大阪 名古屋 横浜 北海道 九州 "
+                               "沖縄 富士山 アメリカ 中国 韓国 フランス")
 # hiragana spellings of common content words (kana-only text has no kanji
 # anchors; IPADIC carries these as separate entries)
-_LEX_SRC += _entries(NOUN, -30, "すし さかな ねこ いぬ ごはん みず おちゃ "
-                                "ひと くるま うち こども")
-_LEX_SRC += _entries(VERB, -30, "たべ たべる のむ のみ みる いく いき かう "
-                                "かい よむ よみ はなし はなす")
+_LEX_SRC += _entries(NOUN, 40, "すし さかな ねこ いぬ ごはん みず おちゃ "
+                               "ひと くるま うち こども")
+_LEX_SRC += _entries(VERB, 40, "たべ たべる のむ のみ みる いく いき かう "
+                               "かい よむ よみ はなし はなす")
 
 JA_LEXICON: Dict[str, List[Tuple[int, str]]] = {}
+
+
+def _load_freq_lexicon() -> int:
+    """Merge the bundled frequency-derived lexicon
+    (resources/ja_lexicon.tsv — generated from the reference's vendored
+    Kuromoji/IPADIC output by experiments/build_ja_lexicon.py) into
+    JA_LEXICON with log-frequency word costs (the IPADIC cost recipe).
+    Returns the number of entries loaded."""
+    import math
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "resources", "ja_lexicon.tsv")
+    n_loaded = 0
+    try:
+        f = open(path, encoding="utf-8")
+    except OSError:
+        return 0
+    with f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) != 3:
+                continue
+            surf, n, cls = parts
+            n = int(n)
+            # positive log-frequency cost (IPADIC recipe): the most
+            # frequent surfaces approach the closed-class floor, rare
+            # ones approach the unknown-edge region
+            cost = max(6, int(100 - 12 * math.log(n + 1)))
+            JA_LEXICON.setdefault(surf, []).append((cost, cls))
+            n_loaded += 1
+    return n_loaded
+
+
+_FREQ_ENTRIES = _load_freq_lexicon()
+
 for _w, _c, _cls in _LEX_SRC:
-    cost = _c - 22 * (len(_w) - 1)   # longest-match bias: longer
-    # dictionary entries are exponentially rarer as char sequences, so a
-    # per-char bonus approximates the IPADIC frequency costs
+    cost = _c
     if (len(_w) == 1 and _cls == NOUN
             and 0x4E00 <= ord(_w) <= 0x9FFF):
         # single-kanji nouns (日/中/本/人...) appear inside compounds far
         # more often than as standalone words — weaken them so unknown
         # compound runs (田中) stay whole
-        cost = -8
+        cost = 75
     JA_LEXICON.setdefault(_w, []).append((cost, _cls))
 
 
@@ -193,12 +235,12 @@ def _script(ch: str) -> str:
 
 
 # unknown-word base costs per script (Kuromoji UnknownDictionary invoke
-# costs, coarsened): katakana/latin runs are usually one word (cheap long
-# edges); kanji compounds favor 1-2 char pieces; hiragana unknowns are
-# heavily penalized (hiragana is closed-class territory — particles and
-# endings should win).
-_UNK_BASE = {"kanji": 45, "kata": 15, "latin": 10, "hira": 95}
-_UNK_PER_CHAR = {"kanji": 5, "kata": 2, "latin": 1, "hira": 40}
+# costs, coarsened; positive scale matching the dictionary costs):
+# katakana/latin runs are usually one word (cheap long edges); kanji
+# compounds favor short pieces; hiragana unknowns are heavily penalized
+# (hiragana is closed-class territory — particles and endings should win).
+_UNK_BASE = {"kanji": 60, "kata": 40, "latin": 30, "hira": 120}
+_UNK_PER_CHAR = {"kanji": 25, "kata": 3, "latin": 2, "hira": 60}
 _UNK_MAX_LEN = {"kanji": 4, "kata": 24, "latin": 48, "hira": 6}
 
 
@@ -234,7 +276,7 @@ class LatticeTokenizer:
                 cost = _UNK_BASE[s] + _UNK_PER_CHAR[s] * L
                 out.append((i + L, cost, UNK))
         if not out:  # always offer the single char so the DP can't strand
-            out.append((i + 1, 200, UNK))
+            out.append((i + 1, 400, UNK))
         return out
 
     def tokenize_tagged(self, text: str) -> List[Tuple[str, str]]:

@@ -223,13 +223,44 @@ class SequenceVectors(WordVectorsModel):
         return out
 
     # ------------------------------------------------------------------
+    def _corpus_key(self):
+        """Identity of the token source: a new vocab, a swapped iterator,
+        or a swapped tokenizer invalidates the flattened-corpus cache.
+        The key holds STRONG references (compared by identity below), so
+        a GC'd-then-reused id can never produce a false hit. In-place
+        mutation of the collection BEHIND an unchanged iterator object is
+        not detectable — call reset_corpus_cache() after doing that."""
+        src = getattr(self, "sentence_iterator", None)
+        if src is None:
+            src = getattr(self, "iterator", None)
+        return (self.vocab, src, getattr(self, "tokenizer_factory", None))
+
+    @staticmethod
+    def _same_key(a, b) -> bool:
+        return (a is not None and b is not None and len(a) == len(b)
+                and all(x is y for x, y in zip(a, b)))
+
+    def reset_corpus_cache(self):
+        """Drop the cached flattened corpus (next fit re-tokenizes)."""
+        self._sg_flat_cache = None
+
     def fit(self):
-        seqs = self.build_vocab() if self.vocab is None else list(
-            self._sequences())
+        sg_fast = (self.train_elements and not self.train_sequences
+                   and self.elements_algo == "skipgram" and not self.use_hs
+                   and self.negative > 0)
+        if self.vocab is None:
+            seqs = self.build_vocab()
+        elif (sg_fast and getattr(self, "_sg_flat_cache", None) is not None
+                and self._same_key(self._sg_flat_cache[0],
+                                   self._corpus_key())):
+            # steady-state epochs on an unchanged corpus: skip host
+            # re-tokenization entirely (equivalent to running epochs=N in
+            # one fit, which flattens once)
+            seqs = None
+        else:
+            seqs = list(self._sequences())
         table = self.lookup_table
-        if (self.train_elements and not self.train_sequences
-                and self.elements_algo == "skipgram" and not self.use_hs
-                and self.negative > 0):
+        if sg_fast:
             return self._fit_sg_corpus(seqs)
         sg_step = make_skipgram_step(table)
         cb_step = (make_cbow_step(table, self.window_size)
@@ -326,8 +357,17 @@ class SequenceVectors(WordVectorsModel):
         B = min(B, max(32, self.vocab.num_words()))
         B = self._sg_round_batch(B)
         # flatten ONCE (token->index lookup is the host-side cost); per-epoch
-        # subsampling only re-draws the keep mask over the fixed index array
-        base_flat, base_sid = self._flatten_corpus(seqs, subsample=False)
+        # subsampling only re-draws the keep mask over the fixed index
+        # array. Cached across fit() calls for an unchanged (vocab,
+        # iterator) — steady-state epochs pay no host re-tokenization
+        key = self._corpus_key()
+        cache = getattr(self, "_sg_flat_cache", None)
+        if seqs is None and cache is not None and self._same_key(cache[0],
+                                                                 key):
+            base_flat, base_sid = cache[1], cache[2]
+        else:
+            base_flat, base_sid = self._flatten_corpus(seqs, subsample=False)
+            self._sg_flat_cache = (key, base_flat, base_sid)
         if len(base_flat) < 2:
             return self
         keep_p = self._keep_probs(base_flat) if self.sampling > 0 else None

@@ -85,6 +85,17 @@ class NeuralNetConfiguration:
     # Mixed precision: compute in this dtype (e.g. "bfloat16" for the MXU)
     # while master params/updater state stay in `dtype`. None = same as dtype.
     compute_dtype: Optional[str] = None
+    # Storage dtype for saved-for-backward activations (conv inputs, BN
+    # inputs): e.g. "float8_e4m3fn" halves bf16 residual traffic at reduced
+    # gradient precision. None = save in the compute dtype (exact).
+    activation_store_dtype: Optional[str] = None
+    # Activation rematerialization: None (save all residuals — XLA default),
+    # "full" (jax.checkpoint the whole forward: save only inputs),
+    # "layer" (checkpoint each vertex: save layer boundaries only), or
+    # "blocks" (checkpoint auto-detected single-live-value segments — for
+    # residual nets this lands on block boundaries). Trades recompute FLOPs
+    # for saved-activation HBM footprint/traffic.
+    remat: Optional[str] = None
 
     @staticmethod
     def builder() -> "NeuralNetConfigurationBuilder":
@@ -115,6 +126,9 @@ class NeuralNetConfiguration:
             ov["dropout"] = self.dropout
         if layer.dtype is None:
             ov["dtype"] = self.dtype
+        if (layer.activation_store_dtype is None
+                and self.activation_store_dtype is not None):
+            ov["activation_store_dtype"] = self.activation_store_dtype
         if layer.gradient_normalization is None:
             ov["gradient_normalization"] = self.gradient_normalization
         if layer.gradient_normalization_threshold is None:
@@ -213,6 +227,21 @@ class NeuralNetConfigurationBuilder:
         The TPU-native analog of the reference's cuDNN half-precision math
         mode (`CudnnConvolutionHelper.java` TENSOR_OP paths)."""
         self._c.compute_dtype = None if dt is None else str(dt); return self
+
+    def activation_store_dtype(self, dt):
+        """Saved-activation storage dtype (e.g. "float8_e4m3fn"): conv/BN
+        residuals are stored compactly and cast back in backward — an HBM
+        traffic/precision trade for bandwidth-bound models."""
+        self._c.activation_store_dtype = None if dt is None else str(dt)
+        return self
+
+    def remat(self, mode):
+        """Activation rematerialization policy: None | "full" | "layer" |
+        "blocks". The TPU-native analog of trading recompute for memory
+        (`jax.checkpoint`); see NeuralNetConfiguration.remat."""
+        if mode is not None and mode not in ("full", "layer", "blocks"):
+            raise ValueError(f"remat must be None|'full'|'layer'|'blocks', got {mode!r}")
+        self._c.remat = mode; return self
 
     def build(self) -> NeuralNetConfiguration:
         return self._c

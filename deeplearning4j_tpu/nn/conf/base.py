@@ -181,6 +181,11 @@ class LayerConf:
     frozen: bool = False                      # transfer learning: exclude from updates
     gradient_normalization: Optional[str] = None   # see GradientNormalization
     gradient_normalization_threshold: Optional[float] = None
+    # Storage dtype for saved-for-backward activations (e.g.
+    # "float8_e4m3fn" halves bf16 residual HBM traffic at ~3-mantissa-bit
+    # gradient precision). Consumed by conv/BN layers; None = save in the
+    # compute dtype (exact).
+    activation_store_dtype: Optional[str] = None
 
     # ---- shape inference -------------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:

@@ -107,6 +107,45 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     # Functional core
     # ------------------------------------------------------------------
+    def _apply_vertex(self, name, rng_i, values, masks, new_state,
+                      new_carries, params, state, train, cdt, out_set,
+                      carries):
+        """Apply one vertex in place (values/masks/new_state/new_carries are
+        mutated). Shared by the plain topo loop and the remat-segment path."""
+        v = self.conf.vertices[name]
+        in_names = self.conf.vertex_inputs[name]
+        ins = [values[i_] for i_ in in_names]
+        in_masks = [masks.get(i_) for i_ in in_names]
+        if isinstance(v, LayerConf):
+            x = ins[0]
+            m = in_masks[0]
+            rec = self.conf.inferred_input_types.get(name)
+            if rec is not None and rec[0] is not None:
+                x = rec[0].apply(x)
+                m = rec[0].apply_mask(m)
+            if name in out_set and isinstance(v, BaseOutputLayerConf):
+                values[name] = (x, m)  # defer loss/activation to caller
+                masks[name] = m
+                return
+            p_v = params[name]
+            # Mixed precision: hidden vertices compute in cdt; output
+            # layers keep master-dtype params (see MultiLayerNetwork).
+            if cdt is not None and not isinstance(v, BaseOutputLayerConf):
+                p_v = cast_floating(p_v, cdt)
+            if carries is not None and getattr(v, "is_recurrent", False):
+                (y, new_carries[name]), new_state[name] = v.apply(
+                    p_v, state[name], x, train=train, rng=rng_i,
+                    mask=m, carry=carries.get(name), return_carry=True)
+            else:
+                y, new_state[name] = v.apply(p_v, state[name], x,
+                                             train=train, rng=rng_i,
+                                             mask=m)
+            values[name] = y
+            masks[name] = v.output_mask(m)
+        else:
+            values[name] = v.apply(ins, in_masks)
+            masks[name] = v.output_mask(in_masks)
+
     def _forward_values(self, params, state, inputs: Dict[str, Any], train,
                         rng, fmasks: Optional[Dict[str, Any]] = None,
                         stop_at_outputs: bool = False, carries=None):
@@ -132,43 +171,107 @@ class ComputationGraph:
         rngs = (jax.random.split(rng, max(1, len(layer_names)))
                 if rng is not None else [None] * len(layer_names))
         out_set = set(self.conf.network_outputs) if stop_at_outputs else set()
+        remat = self.conf.conf.remat
+        if remat in ("layer", "blocks") and train and carries is None:
+            if all(m is None for m in masks.values()):
+                self._forward_segments(
+                    remat, layer_names, rngs, values, masks, new_state,
+                    params, state, train, cdt, out_set)
+                return values, masks, new_state
+            import warnings
+            warnings.warn(
+                f"remat={remat!r} is inactive for this step: segment "
+                "checkpointing does not support mask arrays — training "
+                "falls back to the save-everything path (no activation "
+                "memory savings)", stacklevel=3)
         for i, name in enumerate(layer_names):
-            v = self.conf.vertices[name]
-            in_names = self.conf.vertex_inputs[name]
-            ins = [values[i_] for i_ in in_names]
-            in_masks = [masks.get(i_) for i_ in in_names]
-            if isinstance(v, LayerConf):
-                x = ins[0]
-                m = in_masks[0]
-                rec = self.conf.inferred_input_types.get(name)
-                if rec is not None and rec[0] is not None:
-                    x = rec[0].apply(x)
-                    m = rec[0].apply_mask(m)
-                if name in out_set and isinstance(v, BaseOutputLayerConf):
-                    values[name] = (x, m)  # defer loss/activation to caller
-                    masks[name] = m
-                    continue
-                p_v = params[name]
-                # Mixed precision: hidden vertices compute in cdt; output
-                # layers keep master-dtype params (see MultiLayerNetwork).
-                if cdt is not None and not isinstance(v, BaseOutputLayerConf):
-                    p_v = cast_floating(p_v, cdt)
-                if carries is not None and getattr(v, "is_recurrent", False):
-                    (y, new_carries[name]), new_state[name] = v.apply(
-                        p_v, state[name], x, train=train, rng=rngs[i],
-                        mask=m, carry=carries.get(name), return_carry=True)
-                else:
-                    y, new_state[name] = v.apply(p_v, state[name], x,
-                                                 train=train, rng=rngs[i],
-                                                 mask=m)
-                values[name] = y
-                masks[name] = v.output_mask(m)
-            else:
-                values[name] = v.apply(ins, in_masks)
-                masks[name] = v.output_mask(in_masks)
+            self._apply_vertex(name, rngs[i], values, masks, new_state,
+                               new_carries, params, state, train, cdt,
+                               out_set, carries)
         if carries is not None:
             return values, masks, new_state, new_carries
         return values, masks, new_state
+
+    @_functools.cached_property
+    def _block_segments(self) -> List[List[str]]:
+        """Partition the topo order into remat segments, cutting wherever
+        exactly ONE value is live (consumed by later vertices). For residual
+        nets the skip connection keeps the block input live across the block,
+        so cuts land on block boundaries; linear chains cut at every vertex
+        (≡ per-layer checkpointing)."""
+        layer_names = [n for n in self.conf.topological_order
+                       if n in self.conf.vertices]
+        pos = {n: i for i, n in enumerate(layer_names)}
+        last_use: Dict[str, int] = {}
+        for j, n in enumerate(layer_names):
+            for src in self.conf.vertex_inputs[n]:
+                last_use[src] = max(last_use.get(src, -1), j)
+        outputs = set(self.conf.network_outputs)
+        segments: List[List[str]] = []
+        cur: List[str] = []
+        for i, n in enumerate(layer_names):
+            cur.append(n)
+            if i == len(layer_names) - 1:
+                cut = True
+            else:
+                live = {v for v, lu in last_use.items()
+                        if lu > i and pos.get(v, -1) <= i}
+                live |= {o for o in outputs if pos.get(o, len(layer_names)) <= i}
+                cut = live == {n}
+            if cut:
+                segments.append(cur)
+                cur = []
+        if cur:
+            segments.append(cur)
+        return segments
+
+    def _forward_segments(self, remat, layer_names, rngs, values, masks,
+                          new_state, params, state, train, cdt, out_set):
+        """Run the topo order as jax.checkpoint segments: only segment
+        boundaries (and the small per-segment state updates) are saved for
+        backward; intra-segment activations are rematerialized. Mutates
+        values/masks/new_state (masks stay None — guarded by caller)."""
+        pos = {n: i for i, n in enumerate(layer_names)}
+        segments = ([[n] for n in layer_names] if remat == "layer"
+                    else self._block_segments)
+        last_use: Dict[str, int] = {}
+        for j, n in enumerate(layer_names):
+            for src in self.conf.vertex_inputs[n]:
+                last_use[src] = max(last_use.get(src, -1), j)
+        for seg in segments:
+            seg_set = set(seg)
+            seg_end = pos[seg[-1]]
+            boundary = {}
+            for n in seg:
+                for src in self.conf.vertex_inputs[n]:
+                    if src not in seg_set:
+                        boundary[src] = values[src]
+            seg_params = {n: params[n] for n in seg if n in params}
+            seg_state = {n: state[n] for n in seg if n in state}
+            seg_rngs = ([rngs[pos[n]] for n in seg]
+                        if rngs[0] is not None else None)
+            if seg_rngs is not None:
+                seg_rngs = jnp.stack(seg_rngs)
+            keep = [n for n in seg
+                    if last_use.get(n, -1) > seg_end or n in out_set]
+
+            def seg_fn(boundary, seg_params, seg_state, seg_rngs,
+                       _seg=tuple(seg), _keep=tuple(keep)):
+                vals = dict(boundary)
+                msk = {k: None for k in vals}
+                ns: Dict[str, Any] = {}
+                for k, name in enumerate(_seg):
+                    r = seg_rngs[k] if seg_rngs is not None else None
+                    self._apply_vertex(name, r, vals, msk, ns, {},
+                                       seg_params, seg_state, train, cdt,
+                                       out_set, None)
+                return {n: vals[n] for n in _keep}, ns
+
+            res, ns = jax.checkpoint(seg_fn)(boundary, seg_params, seg_state,
+                                             seg_rngs)
+            values.update(res)
+            masks.update({n: None for n in res})
+            new_state.update(ns)
 
     def _loss_fn(self, params, state, inputs, labels, rng, fmasks=None,
                  lmasks=None, train=True):
@@ -177,7 +280,7 @@ class ComputationGraph:
             params, state, inputs, train, rng, fmasks, stop_at_outputs=True)
         total = jnp.float32(0.0)
         batch = next(iter(inputs.values())).shape[0]
-        for name in self.conf.network_outputs:
+        for i, name in enumerate(self.conf.network_outputs):
             v = self.conf.vertices[name]
             if not isinstance(v, BaseOutputLayerConf):
                 raise ValueError(
@@ -186,9 +289,13 @@ class ComputationGraph:
             x, m = values[name]
             lm = (lmasks or {}).get(name)
             eff = lm if lm is not None else m
+            # output layers may carry input dropout (e.g. GoogLeNet's 0.6
+            # head) — give each output head its own key
+            out_rng = (jax.random.fold_in(rng, i)
+                       if (rng is not None and train) else None)
             total = total + v.loss_score(params[name], state[name], x,
-                                         labels[name], train=train, rng=None,
-                                         mask=eff)
+                                         labels[name], train=train,
+                                         rng=out_rng, mask=eff)
         reg = jnp.float32(0.0)
         for name, p in params.items():
             if p:
@@ -203,12 +310,24 @@ class ComputationGraph:
         return score, new_state
 
     def _make_train_step(self):
+        base_loss = self._loss_fn
+        if self.conf.conf.remat == "full":
+            # save only the step inputs; recompute the entire forward in
+            # backward (jax.checkpoint over the whole loss)
+            def loss_fn(params, state, inputs, labels, rng,
+                        fmasks=None, lmasks=None):
+                f = lambda p, s, i_, l_, r_: base_loss(
+                    p, s, i_, l_, r_, fmasks=fmasks, lmasks=lmasks)
+                return jax.checkpoint(f)(params, state, inputs, labels, rng)
+        else:
+            loss_fn = base_loss
+
         def train_step(params, state, opt_state, step, inputs, labels, rng,
                        fmasks, lmasks):
             (score, new_state), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, state, inputs, labels,
-                                             rng, fmasks=fmasks,
-                                             lmasks=lmasks)
+                loss_fn, has_aux=True)(params, state, inputs, labels,
+                                       rng, fmasks=fmasks,
+                                       lmasks=lmasks)
             if not self.conf.conf.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             new_params, new_opt = {}, {}
@@ -361,7 +480,12 @@ class ComputationGraph:
         analog for graphs). `xs`: [T, batch, ...] array (single-input
         graphs) or dict {input_name: [T, batch, ...]}; `ys` likewise for
         outputs. Pass device-resident arrays (jax.device_put once) — on
-        remote-tunnel backends the link, not the math, is the bottleneck."""
+        remote-tunnel backends the link, not the math, is the bottleneck.
+
+        Listener caveat: iteration_done is replayed AFTER the scan with
+        per-step scores, so every call sees the END-OF-WINDOW params —
+        per-iteration param/update histograms are not faithful on this
+        path (a warning fires for such listeners); use fit() for those."""
         from .conf import OptimizationAlgorithm as OA
 
         if self.params is None:
@@ -403,6 +527,9 @@ class ComputationGraph:
 
             cache[key] = epoch_fn
         n_steps = int(next(iter(xs.values())).shape[0])
+        if self.listeners:
+            from ..optimize.listeners import warn_scan_replay
+            warn_scan_replay(self.listeners)
         for _ in range(epochs):
             self._rng, k = jax.random.split(self._rng)
             (self.params, self.state, self.updater_state, scores) = epoch_fn(

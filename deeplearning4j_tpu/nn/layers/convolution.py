@@ -36,6 +36,42 @@ __all__ = [
 ]
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _conv_stored(x, w, strides, padding, rhs_dilation, store_dtype):
+    """conv_general_dilated whose saved-for-backward input is stored in
+    `store_dtype` (e.g. float8_e4m3fn) instead of the compute dtype: the
+    backward casts it back up and re-derives dx/dw through jax.vjp (the
+    dead primal recompute is DCE'd by XLA, leaving only the transposed
+    convs). Halves the conv-input residual HBM write+read for bf16
+    compute at reduced weight-gradient precision."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=rhs_dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _conv_stored_fwd(x, w, strides, padding, rhs_dilation, store_dtype):
+    y = _conv_stored(x, w, strides, padding, rhs_dilation, store_dtype)
+    return y, (x.astype(jnp.dtype(store_dtype)), w)
+
+
+def _conv_stored_bwd(strides, padding, rhs_dilation, store_dtype, res, g):
+    x_s, w = res
+    x = x_s.astype(w.dtype)
+    _, vjp = jax.vjp(
+        lambda x_, w_: lax.conv_general_dilated(
+            x_, w_, window_strides=strides, padding=padding,
+            rhs_dilation=rhs_dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), x, w)
+    return vjp(g)
+
+
+_conv_stored.defvjp(_conv_stored_fwd, _conv_stored_bwd)
+
+
 class ConvolutionMode:
     STRICT = "strict"
     TRUNCATE = "truncate"
@@ -142,10 +178,19 @@ class ConvolutionLayer(LayerConf):
         # lax.conv requires equal dtypes; follow numpy promotion (matches the
         # implicit promotion dense layers get from jnp.dot)
         ct = jnp.result_type(x.dtype, params["W"].dtype)
-        z = lax.conv_general_dilated(
-            x.astype(ct), params["W"].astype(ct), window_strides=(sh, sw),
-            padding=padding, rhs_dilation=(dh, dw),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        sdt = self.activation_store_dtype
+        if (train and sdt is not None
+                and jnp.dtype(sdt).itemsize < jnp.dtype(ct).itemsize):
+            # compact saved-activation storage: backward reads the conv
+            # input in `sdt` instead of `ct` (HBM traffic/precision trade)
+            z = _conv_stored(x.astype(ct), params["W"].astype(ct),
+                             (sh, sw), padding, (dh, dw), str(sdt))
+        else:
+            z = lax.conv_general_dilated(
+                x.astype(ct), params["W"].astype(ct),
+                window_strides=(sh, sw),
+                padding=padding, rhs_dilation=(dh, dw),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.has_bias:
             z = z + params["b"]
         return self._act(z), state

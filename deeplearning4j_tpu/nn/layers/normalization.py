@@ -106,8 +106,13 @@ class BatchNormalization(LayerConf):
                 y, mean, var = fused_bn_relu(x, gamma, beta, eps=self.eps)
             else:
                 from ...kernels.batchnorm import fused_bn_act
+                sdt = self.activation_store_dtype
+                if (sdt is None or jnp.dtype(sdt).itemsize
+                        >= jnp.dtype(x.dtype).itemsize):
+                    sdt = ""   # exact storage (compute dtype)
                 y, mean, var = fused_bn_act(x, gamma, beta, float(self.eps),
-                                            self.activation or "identity")
+                                            self.activation or "identity",
+                                            str(sdt))
             d = self.decay
             new_state = {
                 "mean": d * state["mean"] + (1 - d) * lax.stop_gradient(mean),

@@ -135,6 +135,19 @@ class MultiLayerNetwork:
         cdt = self._compute_dtype
         if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(cdt)
+        # per-layer activation remat ("blocks" ≡ "layer" for a sequential
+        # net): checkpoint each hidden layer so only layer boundaries are
+        # saved for backward ("full" is handled at the loss level)
+        use_remat = (self.conf.conf.remat in ("layer", "blocks") and train
+                     and carries is None and fmask is None)
+        if (self.conf.conf.remat in ("layer", "blocks") and train
+                and not use_remat):
+            import warnings
+            warnings.warn(
+                f"remat={self.conf.conf.remat!r} is inactive for this "
+                "step: per-layer checkpointing does not support mask "
+                "arrays or TBPTT carries — training falls back to the "
+                "save-everything path", stacklevel=3)
         for i in range(n):
             layer = self.layers[i]
             p_i = params[i]
@@ -150,6 +163,12 @@ class MultiLayerNetwork:
                 (x, new_carries[i]), new_state[i] = layer.apply(
                     p_i, state[i], x, train=train, rng=rngs[i],
                     mask=mask, carry=carries[i], return_carry=True)
+            elif (use_remat and mask is None
+                    and not isinstance(layer, BaseOutputLayerConf)):
+                fn = lambda p_, s_, x_, r_, _l=layer: _l.apply(
+                    p_, s_, x_, train=train, rng=r_, mask=None)
+                x, new_state[i] = jax.checkpoint(fn)(p_i, state[i], x,
+                                                     rngs[i])
             else:
                 x, new_state[i] = layer.apply(p_i, state[i], x,
                                               train=train, rng=rngs[i],
@@ -241,12 +260,25 @@ class MultiLayerNetwork:
         return new_params, new_opt
 
     def _make_train_step(self):
+        base_loss = self._loss_fn
+        if self.conf.conf.remat == "full":
+            # save only the step inputs; recompute the entire forward in
+            # backward (jax.checkpoint over the whole loss)
+            def loss_fn(params, state, x, y, rng, fmask=None, lmask=None,
+                        carries=None):
+                f = lambda p, s, x_, y_, r_: base_loss(
+                    p, s, x_, y_, r_, fmask=fmask, lmask=lmask,
+                    carries=carries)
+                return jax.checkpoint(f)(params, state, x, y, rng)
+        else:
+            loss_fn = base_loss
+
         def train_step(params, state, opt_state, step, x, y, rng, fmask,
                        lmask, carries=None):
             (score, (new_state, new_carries)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, state, x, y, rng,
-                                             fmask=fmask, lmask=lmask,
-                                             carries=carries)
+                loss_fn, has_aux=True)(params, state, x, y, rng,
+                                       fmask=fmask, lmask=lmask,
+                                       carries=carries)
             if not self.conf.conf.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             new_params, new_opt = self.apply_layer_updates(
@@ -408,7 +440,12 @@ class MultiLayerNetwork:
         """fit_scan on pre-stacked [T, batch, ...] arrays. Pass
         device-resident arrays (jax.device_put once) to avoid re-paying the
         host->device transfer on every call — on remote-tunnel backends the
-        link is the bottleneck, not the math."""
+        link is the bottleneck, not the math.
+
+        Listener caveat: iteration_done is replayed AFTER the scan with
+        per-step scores, so every call sees the END-OF-WINDOW params —
+        per-iteration param/update histograms are not faithful on this
+        path (a warning fires for such listeners); use fit() for those."""
         from .conf import OptimizationAlgorithm as OA
 
         if self.params is None:
@@ -460,6 +497,9 @@ class MultiLayerNetwork:
             epoch_fn = cache[key] = self._make_scan_epoch(
                 fm_d is not None, lm_d is not None, tbptt)
         fs_d = jnp.asarray(firsts) if tbptt else None
+        if self.listeners:
+            from ..optimize.listeners import warn_scan_replay
+            warn_scan_replay(self.listeners)
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):

@@ -26,6 +26,20 @@ __all__ = ["FineTuneConfiguration", "TransferLearning",
            "GraphTransferLearning", "TransferLearningHelper"]
 
 
+def _tree_shapes_match(fresh, src) -> bool:
+    """Same keys and leaf shapes — the transfer copy guard."""
+    if not isinstance(fresh, dict) or not isinstance(src, dict):
+        return jax.numpy.shape(fresh) == jax.numpy.shape(src)
+    return (set(fresh) == set(src)
+            and all(_tree_shapes_match(fresh[k], src[k]) for k in src))
+
+
+def _copy_tree(t):
+    """Deep-copy a param/state pytree into fresh device buffers."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.numpy.array(a, copy=True), t)
+
+
 @dataclass
 class FineTuneConfiguration:
     """Hyperparameter overrides applied to all non-frozen layers
@@ -189,13 +203,24 @@ class TransferLearning:
             # ListBuilder re-resolves inheritance; keep frozen flags
             new_net = MultiLayerNetwork(new_conf)
             new_net.init()
-            # copy kept params; reinit'ed layers keep fresh values
+            # copy kept params AND layer state (BN running mean/var — the
+            # reference keeps global stats in the param table, so transfer
+            # carries them; without this a transferred frozen feature
+            # extractor produces wrong eval outputs until stats re-warm);
+            # reinit'ed layers keep fresh values
             new_params = list(new_net.params)
+            new_state = list(new_net.state)
+            src_state = list(src.state)
+            if self._remove_from is not None:
+                src_state = src_state[:self._remove_from]
             for i in range(len(new_conf.layers)):
                 if i < len(params) and i not in reinit and params[i]:
-                    new_params[i] = jax.tree_util.tree_map(
-                        lambda a: jax.numpy.array(a, copy=True), params[i])
+                    new_params[i] = _copy_tree(params[i])
+                if (i < len(src_state) and i not in reinit and src_state[i]
+                        and _tree_shapes_match(new_state[i], src_state[i])):
+                    new_state[i] = _copy_tree(src_state[i])
             new_net.params = tuple(new_params)
+            new_net.state = tuple(new_state)
             return new_net
 
 
@@ -422,18 +447,25 @@ class GraphTransferLearning:
                 gb.set_input_types(*conf.input_types)
             new_graph = ComputationGraph(gb.build())
             new_graph.init()
-            # transfer surviving params, SHAPE-CHECKED: only copy when the
-            # fresh init's shapes match the source exactly (belt and
-            # braces on top of the forward shape propagation above)
+            # transfer surviving params AND layer state, SHAPE-CHECKED:
+            # only copy when the fresh init's shapes match the source
+            # exactly (belt and braces on top of the forward shape
+            # propagation above). State carries BN running mean/var — the
+            # reference keeps global stats in the param table, so a
+            # transferred frozen feature extractor must keep them or eval/
+            # featurize outputs are wrong until the stats re-warm
             new_params = dict(new_graph.params)
+            new_state = dict(new_graph.state)
             for n, p in src.params.items():
                 if n not in new_params or n in reinit or not p:
                     continue
-                fresh = new_params[n]
-                if (set(fresh) == set(p)
-                        and all(jax.numpy.shape(fresh[k])
-                                == jax.numpy.shape(p[k]) for k in p)):
-                    new_params[n] = jax.tree_util.tree_map(
-                        lambda a: jax.numpy.array(a, copy=True), p)
+                if _tree_shapes_match(new_params[n], p):
+                    new_params[n] = _copy_tree(p)
+            for n, s in src.state.items():
+                if n not in new_state or n in reinit or not s:
+                    continue
+                if _tree_shapes_match(new_state[n], s):
+                    new_state[n] = _copy_tree(s)
             new_graph.params = new_params
+            new_graph.state = new_state
             return new_graph

@@ -87,19 +87,37 @@ class Adam(Updater):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    # Storage dtype for the FIRST moment m (e.g. "bfloat16" — its per-step
+    # relative change is 1-beta1 = 0.1, far above bf16's ~3.9e-3 ulp, so
+    # compact storage is safe). The second moment v ALWAYS stays in the
+    # gradient dtype: its EMA step (1-beta2 = 1e-3) is BELOW bf16 ulp, so
+    # a bf16 round-trip would make v sticky — unable to decay after a
+    # gradient spike, silently collapsing the effective step size. None =
+    # everything in the gradient/param dtype (reference-equivalent).
+    state_dtype: Optional[str] = None
 
     def init(self, params):
-        return {"m": _zeros_like(params), "v": _zeros_like(params)}
+        z = {"m": _zeros_like(params), "v": _zeros_like(params)}
+        if self.state_dtype is not None:
+            dt = jnp.dtype(self.state_dtype)
+            z["m"] = _tmap(lambda a: a.astype(dt), z["m"])
+        return z
 
     def update(self, grads, state, step, lr=None):
         lr = self.learning_rate if lr is None else lr
         t = jnp.asarray(step, jnp.float32) + 1.0
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
-        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        # stored-state dtype promotes to the gradient dtype in the math
+        m = _tmap(lambda m_, g: b1 * m_.astype(g.dtype) + (1 - b1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                  state["v"], grads)
         # bias-corrected step size (same form ND4J AdamUpdater uses)
         alpha = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
         upd = _tmap(lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + eps), m, v)
+        if self.state_dtype is not None:
+            dt = jnp.dtype(self.state_dtype)
+            m = _tmap(lambda a: a.astype(dt), m)
         return upd, {"m": m, "v": v}
 
 

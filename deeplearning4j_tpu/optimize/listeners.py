@@ -132,6 +132,8 @@ class ParamAndGradientIterationListener(IterationListener):
     (`optimize/listeners/ParamAndGradientIterationListener.java`). Pulls device
     arrays to host — use sparingly."""
 
+    collects_param_stats = True
+
     def __init__(self, frequency: int = 1, printer: Optional[Callable] = None):
         self.frequency = max(1, int(frequency))
         self.printer = printer or (lambda s: log.info(s))
@@ -146,6 +148,30 @@ class ParamAndGradientIterationListener(IterationListener):
         self.printer(
             f"iter {iteration}: |params| mean abs {np.abs(flat).mean():.3e}, "
             f"l2 {np.linalg.norm(flat):.3e}")
+
+
+def warn_scan_replay(listeners):
+    """fit_scan_arrays replays listeners AFTER the on-device scan with
+    per-step scores only — every iteration_done sees the FINAL params.
+    Warn when attached listeners snapshot params per iteration (histograms
+    would record identical end-of-window values for all steps)."""
+    def flatten(ls):
+        for l in ls:
+            yield l
+            # ComposableIterationListener (and anything list-like) wraps
+            # children in a `listeners` attribute
+            yield from flatten(getattr(l, "listeners", ()))
+
+    bad = sorted({type(l).__name__ for l in flatten(listeners)
+                  if getattr(l, "collects_param_stats", False)})
+    if bad:
+        import warnings
+        warnings.warn(
+            f"listeners {bad} collect per-iteration parameter stats, but "
+            "fit_scan_arrays replays iteration_done after the device scan: "
+            "scores are per-step, param/update stats are end-of-window "
+            "snapshots. Use fit() for faithful per-iteration histograms.",
+            stacklevel=3)
 
 
 class ComposableIterationListener(IterationListener):

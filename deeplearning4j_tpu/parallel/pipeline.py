@@ -147,6 +147,13 @@ class PipelinedNetworkTrainer:
     mean losses, the equivalence the tests assert (the
     `TestCompareParameterAveragingSparkVsSingleMachine.java:44` pattern).
 
+    Dropout-carrying models train with a per-(step, microbatch, stage)
+    PRNG (`fold_in` chain) threaded through the stage functions — the
+    backward recompute folds the SAME key so masks reproduce exactly.
+    Mixed-precision (`compute_dtype`) models cast per-stage exactly as the
+    single-device step does (hidden layers in the compute dtype, output
+    head in the master dtype).
+
     Restrictions: feed-forward layers (no TBPTT carries), no masks.
     """
 
@@ -167,12 +174,6 @@ class PipelinedNetworkTrainer:
             raise ValueError(f"{self.n_stages} stages > {n_layers} layers")
         if not isinstance(model.layers[-1], BaseOutputLayerConf):
             raise ValueError("last layer must be an output layer")
-        for i, layer in enumerate(model.layers):
-            if getattr(layer, "dropout", None):
-                raise ValueError(
-                    f"layer {i} uses dropout; the pipeline stage functions "
-                    "run without per-step RNG so dropout would be silently "
-                    "disabled — use SYNC/TENSOR_PARALLEL")
         self.boundaries = (list(boundaries) if boundaries is not None
                            else self._balance(n_layers))
         self._setup_devices_and_state()
@@ -239,18 +240,37 @@ class PipelinedNetworkTrainer:
 
     # -- per-stage functions (jitted once per stage) ---------------------
     def _stage_forward(self, s: int):
-        """(params, state, x) -> (y, new_state) through layers [lo, hi)."""
+        """(params, state, x, rng) -> (y, new_state) through layers
+        [lo, hi). `rng` is the stage key: split across the stage's layers
+        (dropout/sampling); the backward recompute passes the SAME key so
+        masks reproduce exactly. Mixed precision: hidden layers compute in
+        the compute dtype (params cast per layer, input cast once at stage
+        0), the output head stays master-dtype — mirroring
+        MultiLayerNetwork._forward."""
+        from ..nn.conf.base import cast_floating
+        from ..nn.layers.feedforward import BaseOutputLayerConf
+
         m = self.model
         lo, hi = self._stage_range(s)
         is_last = s == self.n_stages - 1
+        cdt = m._compute_dtype
 
-        def fwd(params, state, x):
+        def fwd(params, state, x, rng):
+            if s == 0 and cdt is not None and jnp.issubdtype(
+                    x.dtype, jnp.floating):
+                x = x.astype(cdt)
             new_state = list(state)
-            for k, i in enumerate(range(lo, hi if not is_last else hi - 1)):
+            idxs = range(lo, hi if not is_last else hi - 1)
+            rngs = jax.random.split(rng, max(1, len(idxs)))
+            for k, i in enumerate(idxs):
                 if i in m.conf.preprocessors:
                     x = m.conf.preprocessors[i].apply(x)
+                p_i = params[k]
+                if cdt is not None and not isinstance(
+                        m.layers[i], BaseOutputLayerConf):
+                    p_i = cast_floating(p_i, cdt)
                 x, new_state[k] = m.layers[i].apply(
-                    params[k], state[k], x, train=True, rng=None, mask=None)
+                    p_i, state[k], x, train=True, rng=rngs[k], mask=None)
             return x, tuple(new_state)
 
         return fwd
@@ -262,15 +282,16 @@ class PipelinedNetworkTrainer:
 
     @functools.cached_property
     def _stage_bwd_jits(self):
-        """Stage backward with recompute: (params, state, x, cot) ->
-        (param_grads, x_cot, new_state)."""
+        """Stage backward with recompute: (params, state, x, cot, rng) ->
+        (param_grads, x_cot, new_state). `rng` must equal the forward
+        stage key (dropout mask reproduction)."""
         jits = []
         for s in range(self.n_stages):
             fwd = self._stage_forward(s)
 
-            def bwd(params, state, x, cot, _fwd=fwd):
+            def bwd(params, state, x, cot, rng, _fwd=fwd):
                 (y, new_state), vjp = jax.vjp(
-                    lambda p, xi: _fwd(p, state, xi), params, x)
+                    lambda p, xi: _fwd(p, state, xi, rng), params, x)
                 gp, gx = vjp((cot, jax.tree_util.tree_map(jnp.zeros_like,
                                                           new_state)))
                 return gp, gx, new_state
@@ -289,18 +310,19 @@ class PipelinedNetworkTrainer:
         out_layer = m.layers[hi - 1]
         out_k = hi - 1 - lo
 
-        def loss_fn(params, state, x, y):
-            h, new_state = fwd(params, state, x)
+        def loss_fn(params, state, x, y, rng):
+            rng_f, out_rng = jax.random.split(rng)
+            h, new_state = fwd(params, state, x, rng_f)
             i = hi - 1
             if i in m.conf.preprocessors:
                 h = m.conf.preprocessors[i].apply(h)
             loss = out_layer.loss_score(params[out_k], state[out_k], h, y,
-                                        train=True, rng=None, mask=None)
+                                        train=True, rng=out_rng, mask=None)
             return loss, new_state
 
-        def grad_fn(params, state, x, y):
+        def grad_fn(params, state, x, y, rng):
             (loss, new_state), vjp = jax.vjp(
-                lambda p, xi: loss_fn(p, state, xi, y), params, x)
+                lambda p, xi: loss_fn(p, state, xi, y, rng), params, x)
             gp, gx = vjp((jnp.float32(1.0),
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state
@@ -366,6 +388,12 @@ class PipelinedNetworkTrainer:
         ys = np.split(y, M)
         S = self.n_stages
         step = jnp.asarray(self.iteration_count, jnp.int32)
+        # per-(step, microbatch, stage) PRNG: dropout-carrying models get
+        # independent masks per microbatch; the backward recompute folds
+        # the SAME key so its masks match the forward exactly
+        self._rng, step_rng = jax.random.split(self._rng)
+        skey = lambda mi, s: jax.random.fold_in(
+            jax.random.fold_in(step_rng, mi), s)
 
         # forward phase: boundary activations per (microbatch, stage)
         acts = [[None] * S for _ in range(M)]
@@ -374,7 +402,8 @@ class PipelinedNetworkTrainer:
             for s in range(S - 1):
                 acts[mi][s] = a
                 a, _ = self._stage_fwd_jits[s](self.stage_params[s],
-                                               self.stage_state[s], a)
+                                               self.stage_state[s], a,
+                                               skey(mi, s))
                 a = jax.device_put(a, self.devices[min(s + 1, S - 1)])
             acts[mi][S - 1] = a
 
@@ -386,7 +415,7 @@ class PipelinedNetworkTrainer:
             yb = jax.device_put(jnp.asarray(ys[mi]), self.devices[S - 1])
             loss, gp, cot, st = self._last_stage_grad(
                 self.stage_params[S - 1], self.stage_state[S - 1],
-                acts[mi][S - 1], yb)
+                acts[mi][S - 1], yb, skey(mi, S - 1))
             losses.append(loss)
             new_states[S - 1] = st
             grad_acc[S - 1] = gp if grad_acc[S - 1] is None else \
@@ -395,7 +424,7 @@ class PipelinedNetworkTrainer:
                 cot = jax.device_put(cot, self.devices[s])
                 gp, cot, st = self._stage_bwd_jits[s](
                     self.stage_params[s], self.stage_state[s],
-                    acts[mi][s], cot)
+                    acts[mi][s], cot, skey(mi, s))
                 new_states[s] = st
                 grad_acc[s] = gp if grad_acc[s] is None else \
                     jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
@@ -447,9 +476,14 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
     residual adds) executes as-is; only the single boundary tensor
     crosses stages, exactly like the chain trainer.
 
+    Dropout and mixed precision (`compute_dtype`) are supported exactly as
+    in the chain trainer: a per-(step, microbatch, stage) PRNG threads
+    through the stage functions (backward recompute folds the same key),
+    and hidden vertices compute in the compute dtype with master-dtype
+    output heads.
+
     Restrictions: single-input/single-output graphs, feed-forward (no
-    recurrent carries), no masks, master-dtype compute (no bf16 policy),
-    DataSet batches.
+    recurrent carries), no masks, DataSet batches.
     """
 
     def __init__(self, model, mesh: Mesh, axis: str = "pipe",
@@ -475,22 +509,12 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
             raise ValueError("output vertex must be last in topo order")
         if not isinstance(conf.vertices[out_name], BaseOutputLayerConf):
             raise ValueError("network output must be an output/loss layer")
-        if model._compute_dtype is not None:
-            raise ValueError(
-                "graph pipeline runs master-dtype compute; build the model "
-                "with compute_dtype=None (the stage functions do not apply "
-                "the mixed-precision policy)")
         for n in self._topo:
             if hasattr(conf.vertices[n], "aux_score"):
                 raise ValueError(
                     f"vertex '{n}' carries an auxiliary loss (aux_score) "
                     "which the per-stage pipeline loss does not propagate; "
                     "use SYNC/TENSOR_PARALLEL for MoE graphs")
-            if getattr(conf.vertices[n], "dropout", None):
-                raise ValueError(
-                    f"vertex '{n}' uses dropout; the pipeline stage "
-                    "functions run without per-step RNG so dropout would "
-                    "be silently disabled — use SYNC/TENSOR_PARALLEL")
         cuts = self._clean_cuts()
         if len(cuts) < self.n_stages - 1:
             raise ValueError(
@@ -583,18 +607,24 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
 
     # -- per-stage functions ---------------------------------------------
     def _stage_forward(self, s: int):
-        from ..nn.conf.base import LayerConf
+        from ..nn.conf.base import LayerConf, cast_floating
+        from ..nn.layers.feedforward import BaseOutputLayerConf
 
         m = self.model
         conf = m.conf
         names, boundary = self._stage_names(s)
         is_last = s == self.n_stages - 1
         run = names[:-1] if is_last else names  # loss head handled apart
+        cdt = m._compute_dtype
 
-        def fwd(params, state, x):
+        def fwd(params, state, x, rng):
+            if s == 0 and cdt is not None and jnp.issubdtype(
+                    x.dtype, jnp.floating):
+                x = x.astype(cdt)
             values = {boundary: x}
             new_state = dict(state)
-            for name in run:
+            rngs = jax.random.split(rng, max(1, len(run)))
+            for k, name in enumerate(run):
                 v = conf.vertices[name]
                 ins = [values[i_] for i_ in conf.vertex_inputs[name]]
                 if isinstance(v, LayerConf):
@@ -602,8 +632,12 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     rec = conf.inferred_input_types.get(name)
                     if rec is not None and rec[0] is not None:
                         h = rec[0].apply(h)
+                    p_v = params[name]
+                    if cdt is not None and not isinstance(
+                            v, BaseOutputLayerConf):
+                        p_v = cast_floating(p_v, cdt)
                     y, new_state[name] = v.apply(
-                        params[name], state[name], h, train=True, rng=None,
+                        p_v, state[name], h, train=True, rng=rngs[k],
                         mask=None)
                     values[name] = y
                 else:
@@ -622,19 +656,20 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
         out_layer = conf.vertices[out_name]
         fwd = self._stage_forward(s)
 
-        def loss_fn(params, state, x, y):
-            h, new_state = fwd(params, state, x)
+        def loss_fn(params, state, x, y, rng):
+            rng_f, out_rng = jax.random.split(rng)
+            h, new_state = fwd(params, state, x, rng_f)
             rec = conf.inferred_input_types.get(out_name)
             if rec is not None and rec[0] is not None:
                 h = rec[0].apply(h)
             loss = out_layer.loss_score(params[out_name], state[out_name],
-                                        h, y, train=True, rng=None,
+                                        h, y, train=True, rng=out_rng,
                                         mask=None)
             return loss, new_state
 
-        def grad_fn(params, state, x, y):
+        def grad_fn(params, state, x, y, rng):
             (loss, new_state), vjp = jax.vjp(
-                lambda p, xi: loss_fn(p, state, xi, y), params, x)
+                lambda p, xi: loss_fn(p, state, xi, y, rng), params, x)
             gp, gx = vjp((jnp.float32(1.0),
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state

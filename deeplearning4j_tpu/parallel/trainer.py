@@ -101,6 +101,16 @@ class ParallelTrainer:
         self.n_data = self.mesh.shape[data_axis]
         if mode == TrainingMode.AVERAGING and strategy != ShardingStrategy.REPLICATED:
             raise ValueError("averaging mode requires replicated params")
+        if mode == TrainingMode.AVERAGING and jax.process_count() > 1:
+            # the multi-host dataset plane (global_batch_array assembly)
+            # only exists for SYNC; AVERAGING would hand host-local arrays
+            # to shard_map over a partially-addressable mesh and fail with
+            # an opaque XLA error deep in dispatch
+            raise ValueError(
+                "AVERAGING mode is single-process only; use "
+                "TrainingMode.SYNC for multi-process meshes (per-step "
+                "gradient allreduce), optionally with a local-SGD cadence "
+                "via averaging_frequency on a single host")
         self._prepare()
 
     # ------------------------------------------------------------------
@@ -239,25 +249,33 @@ class ParallelTrainer:
         phase = (self.stats.time if self.stats is not None
                  else (lambda key: contextlib.nullcontext()))
         with phase("data"):
+            local_shard = bool(getattr(ds, "is_local_shard", False))
             xd, yd, fm, lm = self._to_batch(ds)
             n = self.n_data
+            # a local shard spans only this process's devices
+            n_div = (max(1, n // jax.process_count()) if local_shard else n)
             bs = jax.tree_util.tree_leaves(xd)[0].shape[0]
-            if bs % n:
+            if bs % n_div:
                 # pad the global batch to a multiple of the data axis (the
                 # reference round-robins leftovers; padding + weight-0 would
                 # alter loss scale — we simply drop the remainder)
-                keep = (bs // n) * n
+                keep = (bs // n_div) * n_div
                 if keep == 0:
                     return
                 trim = lambda t: tmap(lambda a: a[:keep], t)
                 xd, yd, fm, lm = trim(xd), trim(yd), trim(fm), trim(lm)
             if jax.process_count() > 1 and self.mode == TrainingMode.SYNC:
-                # multi-host dataset plane: each process holds the GLOBAL
-                # batch definition but contributes only its slice; assemble
-                # the sharded global array (SPMD over DCN+ICI)
+                # multi-host dataset plane: assemble the sharded global
+                # array (SPMD over DCN+ICI). Two sources: a replicated
+                # global batch (each process contributes its slice) or a
+                # LocalShardDataSet from the export/path plane (this
+                # process already holds ONLY its shard —
+                # datasets/export.py, the reference's
+                # RDDTrainingApproach.Export analog)
                 from .distributed import global_batch_array, local_batch_slice
                 bs2 = jax.tree_util.tree_leaves(xd)[0].shape[0]
-                sl = local_batch_slice(bs2)
+                sl = (slice(None) if local_shard
+                      else local_batch_slice(bs2))
                 mk = lambda t: tmap(lambda a: global_batch_array(
                     self.mesh, np.asarray(a)[sl], self.data_axis), t)
                 xd, yd, fm, lm = mk(xd), mk(yd), mk(fm), mk(lm)

@@ -1,0 +1,252 @@
+"""File-backed replayable topic — broker-grade streaming without a broker.
+
+The reference's ingestion rode a real Kafka
+(`streaming/kafka/NDArrayKafkaClient.java`, `NDArrayPublisher/Consumer`,
+`routes/CamelKafkaRouteBuilder.java`): durable append-only topics, consumer
+offsets, replay from any offset. The round-3 `streaming/` module covered the
+transport (ephemeral TCP pub/sub) but not those broker semantics. This
+module supplies them with an append-only segmented log on the filesystem —
+no external broker dependency, same capability surface:
+
+  * `FileTopic` — segmented append-only log; records are length-prefixed
+    blobs; logical offsets (record indices) like Kafka's; torn tails from
+    a crash are detected and truncated on open (Kafka log recovery).
+  * `TopicPublisher` — `publish(array)` appends durably (fsync optional).
+  * `TopicConsumer` — `take(timeout)` / `seek(offset)` / `commit()`;
+    committed offsets persist per consumer GROUP (atomic file replace),
+    so a crashed consumer resumes exactly where it committed — the
+    produce/crash/re-consume contract the TCP tier cannot offer.
+
+The serde is the module's `NDArraySerde` (.npy), so `TopicPublisher` /
+`TopicConsumer` are drop-in durable counterparts of `NDArrayPublisher` /
+`NDArrayConsumer`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import NDArraySerde
+
+__all__ = ["FileTopic", "TopicPublisher", "TopicConsumer"]
+
+_LEN = struct.Struct(">Q")
+_SEG_PREFIX = "segment_"
+_SEG_SUFFIX = ".log"
+
+
+class FileTopic:
+    """Append-only segmented log with logical offsets.
+
+    Layout: `<root>/<name>/segment_<base-offset>.log` holds records
+    `[8-byte big-endian length][payload]` starting at logical offset
+    `<base-offset>`; `<root>/<name>/offsets/<group>.json` holds committed
+    consumer-group offsets."""
+
+    def __init__(self, root: str, name: str = "ndarrays",
+                 segment_bytes: int = 16 << 20, fsync: bool = False):
+        self.dir = os.path.join(str(root), name)
+        os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "offsets"), exist_ok=True)
+        self.segment_bytes = int(segment_bytes)
+        self.fsync = fsync
+        # path -> byte offset of each valid record (built per segment on
+        # first touch, extended incrementally): read(offset) seeks
+        # directly instead of skipping headers from the segment base
+        self._index: dict = {}
+        self._recover()
+
+    # -- log structure ---------------------------------------------------
+    def _segments(self) -> List[Tuple[int, str]]:
+        """[(base_offset, path)] sorted by base offset."""
+        out = []
+        for n in os.listdir(self.dir):
+            if n.startswith(_SEG_PREFIX) and n.endswith(_SEG_SUFFIX):
+                base = int(n[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+                out.append((base, os.path.join(self.dir, n)))
+        return sorted(out)
+
+    @staticmethod
+    def _scan(path: str) -> Tuple[List[int], int]:
+        """(record_byte_offsets, valid_byte_length) — stops at a torn
+        tail."""
+        offs: List[int] = []
+        pos = 0
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            while pos + _LEN.size <= size:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    break
+                (ln,) = _LEN.unpack(head)
+                if pos + _LEN.size + ln > size:
+                    break   # torn record (crash mid-append)
+                f.seek(ln, os.SEEK_CUR)
+                offs.append(pos)
+                pos += _LEN.size + ln
+        return offs, pos
+
+    def _recover(self):
+        """Truncate a torn tail in the last segment (Kafka log recovery),
+        index it, and compute the end offset."""
+        segs = self._segments()
+        if not segs:
+            self._end = 0
+            return
+        base, path = segs[-1]
+        offs, valid = self._scan(path)
+        if valid < os.path.getsize(path):
+            with open(path, "r+b") as f:
+                f.truncate(valid)
+        self._index[path] = offs
+        self._end = base + len(offs)
+
+    # -- producer side ---------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        """Append one record; returns its logical offset. Durable against
+        torn writes (recovery truncates); `fsync=True` makes it durable
+        against power loss too."""
+        segs = self._segments()
+        if segs and os.path.getsize(segs[-1][1]) < self.segment_bytes:
+            path = segs[-1][1]
+        else:
+            path = os.path.join(
+                self.dir, f"{_SEG_PREFIX}{self._end:020d}{_SEG_SUFFIX}")
+        byte_off = os.path.getsize(path) if os.path.exists(path) else 0
+        with open(path, "ab") as f:
+            f.write(_LEN.pack(len(payload)) + payload)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        self._index.setdefault(path, []).append(byte_off)
+        off = self._end
+        self._end += 1
+        return off
+
+    # -- consumer side ---------------------------------------------------
+    def end_offset(self) -> int:
+        """One past the last record currently in the log. Trusts the
+        cached value; a read miss triggers the rescan (`read` below), so
+        cross-process appends are still observed without paying a full
+        last-segment scan per call."""
+        return self._end
+
+    def begin_offset(self) -> int:
+        segs = self._segments()
+        return segs[0][0] if segs else 0
+
+    def read(self, offset: int) -> Optional[bytes]:
+        """Record at logical `offset`, or None past the end."""
+        if offset >= self._end:
+            self._recover()   # another process may have appended
+            if offset >= self._end:
+                return None
+        segs = self._segments()
+        seg = None
+        for base, path in segs:
+            if base <= offset:
+                seg = (base, path)
+            else:
+                break
+        if seg is None:
+            raise KeyError(f"offset {offset} below log start "
+                           f"{self.begin_offset()}")
+        base, path = seg
+        offs = self._index.get(path)
+        if offs is None or offset - base >= len(offs):
+            offs, _ = self._scan(path)
+            self._index[path] = offs
+            if offset - base >= len(offs):
+                return None
+        with open(path, "rb") as f:
+            f.seek(offs[offset - base])
+            head = f.read(_LEN.size)
+            if len(head) < _LEN.size:
+                return None
+            (ln,) = _LEN.unpack(head)
+            data = f.read(ln)
+            return data if len(data) == ln else None
+
+    # -- committed group offsets ----------------------------------------
+    def _offsets_path(self, group: str) -> str:
+        return os.path.join(self.dir, "offsets", f"{group}.json")
+
+    def committed(self, group: str) -> int:
+        try:
+            with open(self._offsets_path(group)) as f:
+                return int(json.load(f)["offset"])
+        except (OSError, ValueError, KeyError):
+            return self.begin_offset()
+
+    def commit(self, group: str, offset: int):
+        p = self._offsets_path(group)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"offset": int(offset)}, f)
+        os.replace(tmp, p)   # atomic: a crash never corrupts the offset
+
+
+class TopicPublisher:
+    """Durable counterpart of `NDArrayPublisher`: publish(array) appends
+    to the topic log."""
+
+    def __init__(self, topic: FileTopic):
+        self.topic = topic
+
+    def publish(self, arr: np.ndarray) -> int:
+        return self.topic.append(NDArraySerde.to_bytes(arr))
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TopicConsumer:
+    """Durable counterpart of `NDArrayConsumer`: take() reads the next
+    record from this group's position; commit() persists it. A consumer
+    restarted after a crash resumes from the last committed offset —
+    records consumed but not committed are redelivered (at-least-once,
+    Kafka's default contract)."""
+
+    def __init__(self, topic: FileTopic, group: str = "default",
+                 from_beginning: bool = False):
+        self.topic = topic
+        self.group = group
+        self.position = (topic.begin_offset() if from_beginning
+                         else topic.committed(group))
+
+    def seek(self, offset: int):
+        self.position = int(offset)
+
+    def take(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            data = self.topic.read(self.position)
+            if data is not None:
+                self.position += 1
+                return NDArraySerde.from_bytes(data)
+            if deadline is None or time.monotonic() >= deadline:
+                return None
+            time.sleep(0.02)
+
+    def commit(self):
+        self.topic.commit(self.group, self.position)
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -134,6 +134,8 @@ class RemoteHistogramIterationListener(TrainingListener):
     """Per-iteration parameter histograms POSTed to a remote endpoint
     (RemoteHistogramIterationListener.java capability)."""
 
+    collects_param_stats = True
+
     def __init__(self, url: str, frequency: int = 1, bins: int = 20,
                  reporter: Optional[WebReporter] = None):
         self.reporter = reporter or WebReporter(url)

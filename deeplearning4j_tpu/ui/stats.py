@@ -104,6 +104,9 @@ class StatsReport(dict):
 
 class StatsListener(TrainingListener):
     TYPE_ID = "StatsListener"
+    # reads model.params per iteration_done — under fit_scan_arrays replay
+    # every call sees end-of-window params (see fit_scan_arrays docstring)
+    collects_param_stats = True
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  session_id: Optional[str] = None, worker_id: str = "local",

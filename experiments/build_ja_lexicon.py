@@ -1,0 +1,124 @@
+"""Generate the bundled Japanese lexicon from the reference's vendored
+IPADIC-features corpus (Kuromoji output over the public-domain novel
+"Botchan" — `deeplearning4j-nlp-japanese/src/test/resources/
+bocchan-ipadic-features.txt`). This is DATA derived from the reference's
+test resources (like the bundled MNIST pixel batches), not code.
+
+Writes `deeplearning4j_tpu/resources/ja_lexicon.tsv`:
+    surface \t count \t coarse_class
+for the most frequent non-symbol surfaces. `lattice_ja` converts counts to
+word costs (log-frequency, the IPADIC recipe) and merges the curated
+closed-class entries on top.
+
+Run: python experiments/build_ja_lexicon.py [--top 4000]
+"""
+import argparse
+import collections
+import os
+import sys
+
+SRC = ("/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp-japanese"
+       "/src/test/resources/bocchan-ipadic-features.txt")
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "deeplearning4j_tpu", "resources", "ja_lexicon.tsv")
+
+# IPADIC POS1(,POS2) -> coarse lattice class (lattice_ja class tags)
+def coarse(pos1: str, pos2: str) -> str:
+    if pos1 == "助詞":
+        return "P"
+    if pos1 == "助動詞":
+        return "A"
+    if pos1 == "動詞":
+        return "V"
+    if pos1 == "形容詞":
+        return "J"
+    if pos1 in ("副詞", "接続詞", "感動詞", "連体詞", "フィラー", "接頭詞"):
+        return "D"
+    if pos1 == "名詞":
+        return "S" if pos2 == "接尾" else "N"
+    return ""   # 記号 etc: skip
+
+
+GOLD_OUT = os.path.join(os.path.dirname(OUT), "ja_gold_segmentation.tsv")
+JAWIKI = ("/root/reference/deeplearning4j-nlp-parent/"
+          "deeplearning4j-nlp-japanese/src/test/resources/"
+          "jawikisentences-ipadic-features.txt")
+
+
+def read_tokens(path):
+    """(surface, pos1) per line of a Kuromoji features dump."""
+    toks = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if "\t" not in line:
+                continue
+            surf, feats = line.split("\t", 1)
+            parts = feats.split(",")
+            toks.append((surf, parts[0], parts[1] if len(parts) > 1 else ""))
+    return toks
+
+
+def sentences_from(toks, max_sents, min_len=5, max_len=40):
+    """Group a features dump into gold sentences at 。 boundaries.
+    Returns [(text, [gold_surfaces])]: text keeps symbols (realistic
+    input), gold keeps only non-symbol tokens."""
+    out, cur = [], []
+    for surf, pos1, _ in toks:
+        cur.append((surf, pos1))
+        if surf == "。":
+            gold = [s for s, p in cur if p not in ("記号",) and s.strip()
+                    and "|" not in s]
+            text = "".join(s for s, _ in cur)
+            if min_len <= len(gold) <= max_len and "《" not in text:
+                out.append((text, gold))
+            cur = []
+            if len(out) >= max_sents:
+                break
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--top", type=int, default=4000)
+    ap.add_argument("--min-count", type=int, default=2)
+    ap.add_argument("--holdout", type=int, default=15000,
+                    help="tail tokens excluded from the lexicon and used "
+                         "as gold segmentation sentences")
+    ap.add_argument("--gold-sents", type=int, default=150)
+    a = ap.parse_args()
+
+    toks = read_tokens(SRC)
+    train, tail = toks[:-a.holdout], toks[-a.holdout:]
+
+    counts = collections.Counter()
+    cls_votes = collections.defaultdict(collections.Counter)
+    for surf, pos1, pos2 in train:
+        c = coarse(pos1, pos2)
+        if not c or not surf.strip():
+            continue
+        counts[surf] += 1
+        cls_votes[surf][c] += 1
+    rows = []
+    for surf, n in counts.most_common():
+        if n < a.min_count or len(rows) >= a.top:
+            break
+        cls = cls_votes[surf].most_common(1)[0][0]
+        rows.append((surf, n, cls))
+    with open(OUT, "w", encoding="utf-8") as f:
+        for surf, n, cls in rows:
+            f.write(f"{surf}\t{n}\t{cls}\n")
+    print(f"wrote {len(rows)} entries to {OUT}", file=sys.stderr)
+
+    # gold = held-out bocchan tail (in-corpus but unseen) + the jawiki
+    # sentences (out-of-domain)
+    gold = sentences_from(tail, a.gold_sents)
+    gold += sentences_from(read_tokens(JAWIKI), 50)
+    with open(GOLD_OUT, "w", encoding="utf-8") as f:
+        for text, toks_ in gold:
+            f.write(text + "\t" + "|".join(toks_) + "\n")
+    print(f"wrote {len(gold)} gold sentences to {GOLD_OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,24 @@ def main():
     np.save(f"{outdir}/params_p{pid}.npy", flat)
     print(f"proc {pid} done score={trainer.score():.6f}")
 
+    # --- export/path-based dataset plane (RDDTrainingApproach.Export
+    # analog): write per-process shard files, train reading ONLY this
+    # process's shards, params must equal the in-memory run above ---------
+    from deeplearning4j_tpu.datasets.export import (export_sharded,
+                                                    ShardedPathDataSetIterator)
+
+    exp_dir = f"{outdir}/export_p{pid}"   # per-process dir, same content
+    shard_paths = export_sharded([ds], exp_dir, n_shards=n_procs)
+    model2 = MultiLayerNetwork(conf).init()
+    trainer2 = ParallelTrainer(model2, mesh=mesh, mode=TrainingMode.SYNC)
+    it = ShardedPathDataSetIterator(shard_paths[pid])
+    for _ in range(5):
+        trainer2.fit(it)
+    flat2 = np.concatenate([np.asarray(l).ravel()
+                            for l in jax.tree_util.tree_leaves(model2.params)])
+    np.save(f"{outdir}/params_export_p{pid}.npy", flat2)
+    print(f"proc {pid} export-plane done score={trainer2.score():.6f}")
+
 
 if __name__ == "__main__":
     main()

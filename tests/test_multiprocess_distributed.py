@@ -68,3 +68,10 @@ def test_two_process_training_matches_single_process(tmp_path):
         single.fit(ds)
     np.testing.assert_allclose(p0, single.params_flat(), rtol=2e-5,
                                atol=1e-6)
+
+    # export/path-based plane (each process read ONLY its shard files):
+    # identical across processes AND identical to the in-memory run
+    e0 = np.load(tmp_path / "params_export_p0.npy")
+    e1 = np.load(tmp_path / "params_export_p1.npy")
+    np.testing.assert_allclose(e0, e1, rtol=0, atol=0)
+    np.testing.assert_allclose(e0, p0, rtol=0, atol=0)

@@ -230,18 +230,12 @@ def test_parallel_trainer_pipeline_dispatches_graph():
 
 
 def test_pipelined_graph_guards_and_maximize():
-    """Round-3 review regressions: compute_dtype and aux-loss graphs are
-    rejected loudly; invalid user boundaries are rejected; maximize
-    matches single-device."""
-    from deeplearning4j_tpu.models.zoo import resnet50
+    """Round-3 review regressions: aux-loss graphs and invalid user
+    boundaries are rejected loudly; maximize matches single-device."""
     from deeplearning4j_tpu.parallel import make_mesh
     from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
 
     mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
-    bf16 = resnet50(n_classes=4, image=16, blocks=(1,), width=8,
-                    compute_dtype="bfloat16").init()
-    with pytest.raises(ValueError, match="compute_dtype"):
-        PipelinedGraphTrainer(bf16, mesh)
     with pytest.raises(ValueError, match="boundaries"):
         PipelinedGraphTrainer(_tiny_resnet(), mesh, boundaries=[1_000])
 
@@ -279,30 +273,126 @@ def test_pipelined_graph_guards_and_maximize():
                 np.asarray(single.params[name][k]), rtol=2e-5, atol=1e-6)
 
 
-def test_pipeline_rejects_dropout_models():
-    """Stage functions run without per-step RNG: dropout would silently
-    disable, so both trainers reject it loudly (round-3 review)."""
-    from deeplearning4j_tpu.nn.conf.input_type import InputType as IT
-    from deeplearning4j_tpu.nn.graph import ComputationGraph
-    from deeplearning4j_tpu.parallel.pipeline import (
-        PipelinedGraphTrainer, PipelinedNetworkTrainer)
+def test_pipeline_dropout_models_train():
+    """Round-4 (VERDICT #2): dropout models TRAIN through the pipeline —
+    per-(step, microbatch, stage) PRNG threads through the stage
+    functions. Checks: dropout is genuinely active (different step keys
+    give different gradients), training is seed-deterministic, and loss
+    decreases."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedNetworkTrainer
 
     mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
-    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
-            .list()
-            .layer(DenseLayer(n_out=8, activation="relu", dropout=0.5))
-            .layer(OutputLayer(n_out=2, loss="mcxent"))
-            .set_input_type(InputType.feed_forward(4))
-            .build())
-    with pytest.raises(ValueError, match="dropout"):
-        PipelinedNetworkTrainer(MultiLayerNetwork(conf).init(), mesh)
 
-    b = NeuralNetConfiguration.builder().seed(0).graph_builder()
-    b.add_inputs("in")
-    b.add_layer("h", DenseLayer(n_out=8, activation="tanh", dropout=0.5),
-                "in")
-    b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "h")
-    b.set_outputs("out")
-    b.set_input_types(IT.feed_forward(3))
-    with pytest.raises(ValueError, match="dropout"):
-        PipelinedGraphTrainer(ComputationGraph(b.build()).init(), mesh)
+    def build(dropout=0.5):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu",
+                                  dropout=dropout))
+                .layer(DenseLayer(n_out=16, activation="relu",
+                                  dropout=dropout))
+                .layer(OutputLayer(n_out=2, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    r = np.random.default_rng(3)
+    x = r.normal(size=(8, 4)).astype(np.float32)
+    yidx = r.integers(0, 2, 8)
+    x[:, 0] += yidx * 2.0
+    y = np.eye(2, dtype=np.float32)[yidx]
+    ds = DataSet(x, y)
+
+    # determinism: same seed -> identical trained params
+    t1 = PipelinedNetworkTrainer(build(), mesh, n_microbatches=2)
+    t2 = PipelinedNetworkTrainer(build(), mesh, n_microbatches=2)
+    for _ in range(3):
+        t1.fit(ds)
+        t2.fit(ds)
+    p1 = t1.sync_back().params
+    p2 = t2.sync_back().params
+    for a, b in zip(p1, p2):
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]))
+
+    # dropout active: step 1 vs a dropout-free clone diverge immediately
+    t3 = PipelinedNetworkTrainer(build(dropout=None), mesh,
+                                 n_microbatches=2)
+    t3.fit(ds)
+    t4 = PipelinedNetworkTrainer(build(), mesh, n_microbatches=2)
+    t4.fit(ds)
+    d = np.abs(np.asarray(t3.sync_back().params[0]["W"])
+               - np.asarray(t4.sync_back().params[0]["W"])).max()
+    assert d > 1e-6, "dropout had no effect on the pipelined step"
+
+    # convergence
+    t5 = PipelinedNetworkTrainer(build(), mesh, n_microbatches=2)
+    t5.fit(ds)
+    s0 = t5.score()
+    for _ in range(25):
+        t5.fit(ds)
+    assert t5.score() < s0
+
+
+def test_pipelined_alexnet_with_dropout_converges():
+    """VERDICT #2 gate: the zoo's AlexNet (dropout 0.5 heads) trains
+    through the pipeline and converges on a small separable set."""
+    from deeplearning4j_tpu.models.zoo import alexnet
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedNetworkTrainer
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    net = alexnet(n_classes=3, image=64, updater=Sgd(0.003), seed=4).init()
+    r = np.random.default_rng(8)
+    yidx = r.integers(0, 3, 6)
+    x = r.normal(size=(6, 64, 64, 3)).astype(np.float32)
+    x += yidx[:, None, None, None] * 1.0
+    y = np.eye(3, dtype=np.float32)[yidx]
+    ds = DataSet(x, y)
+    tr = PipelinedNetworkTrainer(net, mesh, n_microbatches=2)
+    scores = []
+    for _ in range(15):
+        tr.fit(ds)
+        scores.append(tr.score())
+    assert all(np.isfinite(s) for s in scores)
+    # dropout keeps per-step scores noisy; require sustained improvement
+    assert min(scores[-3:]) < scores[0]
+
+
+def test_pipelined_graph_bf16_matches_single_device():
+    """VERDICT #2 gate: the bf16 compute-policy ResNet (the perf config)
+    trains through the graph pipeline; at M=1 it matches single-device
+    bf16 training within bf16 tolerance."""
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.pipeline import PipelinedGraphTrainer
+
+    def build():
+        return resnet50(n_classes=4, image=16, seed=11, blocks=(1, 1),
+                        width=8, compute_dtype="bfloat16",
+                        updater=Sgd(0.05)).init()
+
+    r = np.random.default_rng(12)
+    x = r.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 8)]
+    ds = DataSet(x, y)
+    single, piped = build(), build()
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    trainer = PipelinedGraphTrainer(piped, mesh, n_microbatches=1)
+    for _ in range(3):
+        single.fit(ds)
+        trainer.fit(ds)
+    trainer.sync_back()
+    for name in single.params:
+        for k in single.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(piped.params[name][k]),
+                np.asarray(single.params[name][k]), rtol=2e-2, atol=2e-3,
+                err_msg=f"{name}/{k}")
+    # and the microbatched schedule converges under bf16
+    tr2 = PipelinedGraphTrainer(build(), mesh, n_microbatches=2)
+    tr2.fit(ds)
+    s0 = tr2.score()
+    for _ in range(10):
+        tr2.fit(ds)
+    assert tr2.score() < s0

@@ -1,0 +1,232 @@
+"""Regression tests for round-3 advisor findings + round-4 features
+(remat modes, Adam state dtype)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd)
+
+
+def _bn_net():
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=6, activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _train_some(m, steps=4):
+    r = np.random.default_rng(0)
+    for _ in range(steps):
+        x = r.normal(1.5, 2.0, size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[r.integers(0, 2, 8)]
+        m.fit(DataSet(x, y))
+    return m
+
+
+def test_transfer_builder_carries_bn_state():
+    """Advisor r3 (medium): TransferLearning must carry layer state (BN
+    running mean/var), not just params — else a transferred frozen feature
+    extractor infers with reset stats."""
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+    src = _train_some(_bn_net())
+    src_mean = np.asarray(src.state[1]["mean"])
+    assert np.abs(src_mean).max() > 1e-3  # stats actually moved
+    new = (TransferLearning.Builder(src)
+           .set_feature_extractor(1)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=3, loss="mcxent"))
+           .build())
+    np.testing.assert_allclose(np.asarray(new.state[1]["mean"]), src_mean)
+    np.testing.assert_allclose(np.asarray(new.state[1]["var"]),
+                               np.asarray(src.state[1]["var"]))
+
+
+def test_graph_transfer_carries_bn_state():
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import GraphTransferLearning
+
+    b = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(4)))
+    b.add_layer("d", DenseLayer(n_out=6, activation="identity"), "in")
+    b.add_layer("bn", BatchNormalization(activation="relu"), "d")
+    b.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "bn")
+    b.set_outputs("out")
+    src = ComputationGraph(b.build()).init()
+    _train_some(src)
+    src_mean = np.asarray(src.state["bn"]["mean"])
+    assert np.abs(src_mean).max() > 1e-3
+    new = (GraphTransferLearning.GraphBuilder(src)
+           .set_feature_extractor("bn")
+           .nout_replace("out", 3)
+           .build())
+    np.testing.assert_allclose(np.asarray(new.state["bn"]["mean"]), src_mean)
+    np.testing.assert_allclose(np.asarray(new.state["bn"]["var"]),
+                               np.asarray(src.state["bn"]["var"]))
+
+
+def test_averaging_multiprocess_rejected(monkeypatch):
+    """Advisor r3 (low): AVERAGING on a multi-process mesh must fail with a
+    clear error, not an opaque shard_map addressability error."""
+    import jax
+    from deeplearning4j_tpu.parallel.trainer import (ParallelTrainer,
+                                                     TrainingMode)
+    m = _bn_net()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="single-process"):
+        ParallelTrainer(m, mode=TrainingMode.AVERAGING)
+
+
+def test_h5_attr_missing_name_errors(tmp_path):
+    """Advisor r3 (low): names listed in layer_names/weight_names attrs but
+    absent from the group must fail loudly, not silently shift pairs."""
+    h5py = pytest.importorskip("h5py")
+    from deeplearning4j_tpu.modelimport.trainedmodels import (
+        _collect_weight_pairs)
+    p = tmp_path / "w.h5"
+    with h5py.File(p, "w") as f:
+        g1 = f.create_group("dense_1")
+        g1.attrs["weight_names"] = [b"dense_1_W", b"dense_1_b"]
+        g1.create_dataset("dense_1_W", data=np.ones((3, 2), np.float32))
+        # dense_1_b deliberately missing
+        f.attrs["layer_names"] = [b"dense_1"]
+    with h5py.File(p, "r") as f:
+        with pytest.raises(ValueError, match="missing from the group"):
+            _collect_weight_pairs(f)
+
+
+def test_fit_scan_warns_for_param_stats_listeners():
+    """Advisor r3 (low): fit_scan_arrays replays listeners with end-of-window
+    params; histogram-collecting listeners get a warning."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener)
+    m = _bn_net()
+    m.set_listeners(ParamAndGradientIterationListener(printer=lambda s: None))
+    r = np.random.default_rng(0)
+    xs = jnp.asarray(r.normal(size=(2, 4, 4)).astype(np.float32))
+    ys = jnp.asarray(np.eye(2, dtype=np.float32)[r.integers(0, 2, (2, 4))])
+    with pytest.warns(UserWarning, match="end-of-window"):
+        m.fit_scan_arrays(xs, ys)
+
+
+@pytest.mark.parametrize("mode", ["blocks", "layer", "full"])
+def test_graph_remat_matches_no_remat(mode):
+    """remat modes are numerically faithful to the default
+    (save-everything) training path."""
+    from deeplearning4j_tpu.models.zoo import resnet50
+
+    def run(remat):
+        m = resnet50(image=16, n_classes=3, blocks=(1,), width=4,
+                     compute_dtype=None, remat=remat).init()
+        r = np.random.default_rng(0)
+        x = r.normal(size=(2, 16, 16, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 2)]
+        for _ in range(2):
+            m._fit_batch(DataSet(x, y))
+        return m.params_flat()
+
+    base = run(None)
+    got = run(mode)
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-6)
+
+
+def test_block_segments_cut_at_residual_boundaries():
+    from deeplearning4j_tpu.models.zoo import resnet50
+    g = resnet50(image=16, n_classes=3, blocks=(2,), width=4,
+                 compute_dtype=None, remat="blocks")
+    segs = g._block_segments
+    flat = [n for s in segs for n in s]
+    layer_names = [n for n in g.conf.topological_order if n in g.conf.vertices]
+    assert flat == layer_names           # partition covers exactly, in order
+    # the non-downsample block (s0b1) holds its skip live across the whole
+    # block -> one multi-vertex segment containing its add vertex
+    multi = [s for s in segs if len(s) > 1]
+    assert any("s0b1_add" in s for s in multi)
+
+
+@pytest.mark.parametrize("mode", ["layer", "full"])
+def test_multilayer_remat_matches_no_remat(mode):
+    """The remat knob must work (not silently no-op) on MultiLayerNetwork
+    too."""
+    def run(remat):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.1)).remat(remat)
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        m = MultiLayerNetwork(conf).init()
+        return _train_some(m, 3).params_flat()
+
+    np.testing.assert_allclose(run(mode), run(None), rtol=2e-5, atol=2e-6)
+
+
+def test_scan_replay_warns_through_composable():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.listeners import (
+        ComposableIterationListener, ParamAndGradientIterationListener)
+    m = _bn_net()
+    m.set_listeners(ComposableIterationListener(
+        ParamAndGradientIterationListener(printer=lambda s: None)))
+    r = np.random.default_rng(0)
+    xs = jnp.asarray(r.normal(size=(2, 4, 4)).astype(np.float32))
+    ys = jnp.asarray(np.eye(2, dtype=np.float32)[r.integers(0, 2, (2, 4))])
+    with pytest.warns(UserWarning, match="end-of-window"):
+        m.fit_scan_arrays(xs, ys)
+
+
+def test_remat_mask_fallback_warns():
+    """remat silently falling back for masked batches was a review finding —
+    it must warn."""
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Sgd(0.1)).remat("layer")
+            .list()
+            .layer(GravesLSTM(n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 5))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    x = np.zeros((2, 5, 3), np.float32)
+    y = np.zeros((2, 5, 2), np.float32)
+    fm = np.ones((2, 5), np.float32)
+    with pytest.warns(UserWarning, match="inactive"):
+        m.fit(DataSet(x, y, features_mask=fm))
+
+
+def test_adam_state_dtype():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.updaters import Adam
+    u = Adam(1e-3, state_dtype="bfloat16")
+    p = {"w": jnp.ones((4, 4), jnp.float32)}
+    st = u.init(p)
+    assert st["m"]["w"].dtype == jnp.bfloat16
+    # v must STAY f32: its 1e-3 EMA step is below bf16 ulp (a bf16 v
+    # could never decay after a spike — review finding)
+    assert st["v"]["w"].dtype == jnp.float32
+    g = {"w": jnp.full((4, 4), 0.5, jnp.float32)}
+    upd, st2 = u.update(g, st, 0)
+    assert st2["m"]["w"].dtype == jnp.bfloat16
+    assert st2["v"]["w"].dtype == jnp.float32
+    assert upd["w"].dtype == jnp.float32   # math stays in gradient dtype
+    assert bool(jnp.all(jnp.isfinite(upd["w"])))
+    # v genuinely decays with zero gradients (the bf16-v failure mode)
+    _, stv = u.update(g, st, 0)
+    for i in range(3):
+        _, stv = u.update({"w": jnp.zeros((4, 4))}, stv, i + 1)
+    assert float(stv["v"]["w"].max()) < float(st2["v"]["w"].max())
+    # serde round-trip keeps the knob
+    from deeplearning4j_tpu.nn.updaters import from_dict
+    assert from_dict(u.to_dict()).state_dtype == "bfloat16"

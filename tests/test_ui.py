@@ -508,6 +508,31 @@ def test_legacy_remote_iteration_listeners():
     rep.close()
 
 
+def test_webreporter_backpressure_dead_endpoint():
+    """Round-4 (VERDICT #10): with the endpoint dead, the bounded queue
+    drops the OLDEST payloads and report() never blocks — a down UI host
+    cannot stall or OOM the training loop."""
+    from deeplearning4j_tpu.ui.legacy_listeners import WebReporter
+
+    rep = WebReporter("http://127.0.0.1:1/legacy", timeout=0.2,
+                      queue_size=8)
+    t0 = time.time()
+    for i in range(50):
+        rep.report({"i": i})
+    elapsed = time.time() - t0
+    assert elapsed < 1.0, f"report() blocked the caller ({elapsed:.2f}s)"
+    assert rep.pending <= 8            # bounded, never grows past maxlen
+    with rep._lock:
+        kept = [p["i"] for p in rep._queue]
+    # newest survive; oldest dropped (deque maxlen semantics). The worker
+    # may have popped/retried the head concurrently, so only bound-check
+    # the window start
+    assert kept == sorted(kept)
+    assert kept[0] >= 50 - 8
+    assert kept[-1] == 49
+    rep.close()
+
+
 def test_sqlite_stats_storage_round_trip(tmp_path):
     """SQLite-backed storage (J7FileStatsStorage/MapDBStatsStorage role):
     durable across connections, same SPI surface + events."""
