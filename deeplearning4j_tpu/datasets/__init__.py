@@ -7,6 +7,9 @@ from .export import (
     export_datasets, export_sharded, load_dataset, PathDataSetIterator,
     ShardedPathDataSetIterator, LocalShardDataSet,
 )
+from .labeled_point import (
+    LabeledPoint, LabeledPointDataSetIterator, labeled_points_to_dataset,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
@@ -15,4 +18,6 @@ __all__ = [
     "ExistingDataSetIterator",
     "export_datasets", "export_sharded", "load_dataset",
     "PathDataSetIterator", "ShardedPathDataSetIterator", "LocalShardDataSet",
+    "LabeledPoint", "LabeledPointDataSetIterator",
+    "labeled_points_to_dataset",
 ]

@@ -21,12 +21,16 @@ __all__ = ["TrainingStats", "profiler_trace"]
 
 
 class _Event:
-    __slots__ = ("key", "start", "duration_ms")
+    __slots__ = ("key", "start", "duration_ms", "epoch_ms")
 
-    def __init__(self, key: str, start: float, duration_ms: float):
+    def __init__(self, key: str, start: float, duration_ms: float,
+                 epoch_ms: Optional[int] = None):
         self.key = key
         self.start = start
         self.duration_ms = duration_ms
+        # offset-corrected wall-clock stamp (cross-host comparable when a
+        # CoordinatorTimeSource is attached — NTPTimeSource role)
+        self.epoch_ms = epoch_ms
 
 
 class TrainingStats:
@@ -34,24 +38,46 @@ class TrainingStats:
     with `with stats.time("step"):` blocks; values are wall-clock ms.
     NOTE: timing a phase that only *dispatches* async device work measures
     dispatch unless the caller synchronizes — ParallelTrainer's
-    collect_stats mode blocks on the score each step for honest numbers."""
+    collect_stats mode blocks on the score each step for honest numbers.
 
-    def __init__(self):
+    `time_source` (parallel/timesource.py — the reference's
+    `NTPTimeSource`/`TimeSourceProvider` tier) stamps every event with an
+    offset-corrected epoch time so multi-host phase stats merge onto one
+    timeline; default = local system clock."""
+
+    def __init__(self, time_source=None):
+        if time_source is None:
+            # env-selected provider (TimeSourceProvider role):
+            # DL4J_TPU_TIMESOURCE=coordinator gives corrected stamps
+            from .timesource import get_time_source
+            time_source = get_time_source()
+        self.time_source = time_source
         self._events: List[_Event] = []
         self._t0 = time.time()
 
     @contextlib.contextmanager
     def time(self, key: str):
         start = time.time()
+        stamp = self.time_source.current_time_millis()
         try:
             yield
         finally:
             self._events.append(
-                _Event(key, start - self._t0, (time.time() - start) * 1e3))
+                _Event(key, start - self._t0, (time.time() - start) * 1e3,
+                       stamp))
 
     def add(self, key: str, duration_ms: float):
+        # stamp the phase START (matching time()): recording time minus
+        # duration, so merged timelines are not skewed by event length
         self._events.append(
-            _Event(key, time.time() - self._t0, float(duration_ms)))
+            _Event(key, time.time() - self._t0, float(duration_ms),
+                   int(self.time_source.current_time_millis()
+                       - duration_ms)))
+
+    def events(self) -> List[Dict]:
+        """Cross-host mergeable event records (EventStats analog)."""
+        return [{"key": e.key, "epoch_ms": e.epoch_ms,
+                 "duration_ms": e.duration_ms} for e in self._events]
 
     def reset(self):
         """Drop recorded events (fresh measurement window)."""

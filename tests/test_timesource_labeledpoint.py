@@ -1,0 +1,151 @@
+"""Round-4 gap closers: cross-node time source (NTPTimeSource analog) and
+the LabeledPoint vector-format ingestion bridge (MLlib fit overloads)."""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer, Sgd)
+
+
+# ----------------------------- time source --------------------------------
+
+def test_coordinator_time_source_recovers_offset():
+    """Client clock skewed by a known amount; the NTP 4-timestamp exchange
+    against the reference TimeServer recovers it (min-delay sample)."""
+    from deeplearning4j_tpu.parallel.timesource import (CoordinatorTimeSource,
+                                                        TimeServer)
+    SKEW = 123.456   # seconds of artificial client-clock error
+    with TimeServer() as srv:
+        client_clock = lambda: time.time() + SKEW
+        ts = CoordinatorTimeSource(srv.host, srv.port, samples=8,
+                                   clock=client_clock)
+        off = ts.offset_ms()
+        # offset should cancel the skew (loopback RTT ~sub-ms)
+        assert abs(off + SKEW * 1000) < 50, off
+        # corrected time ~= server time
+        drift_ms = abs(ts.current_time_millis() - time.time() * 1000)
+        assert drift_ms < 100, drift_ms
+
+
+def test_time_source_refresh_and_caching():
+    from deeplearning4j_tpu.parallel.timesource import (CoordinatorTimeSource,
+                                                        TimeServer)
+    with TimeServer() as srv:
+        ts = CoordinatorTimeSource(srv.host, srv.port, samples=2,
+                                   frequency_sec=1000.0)
+        o1 = ts.offset_ms()
+        measured_at = ts._measured_at
+        ts.offset_ms()                       # within frequency: cached
+        assert ts._measured_at == measured_at
+        ts.frequency_sec = 0.0               # stale: background refresh
+        ts.offset_ms()                       # serves stale, kicks thread
+        deadline = time.time() + 5
+        while ts._measured_at == measured_at and time.time() < deadline:
+            time.sleep(0.02)
+        assert ts._measured_at > measured_at
+        assert abs(o1) < 50
+
+
+def test_time_source_survives_dead_server():
+    """NTPTimeSource behavior: after a successful first measurement, a
+    dead time server must never crash the caller — the stale offset
+    keeps being served (background refresh logs and backs off)."""
+    from deeplearning4j_tpu.parallel.timesource import (CoordinatorTimeSource,
+                                                        TimeServer)
+    srv = TimeServer()
+    ts = CoordinatorTimeSource(srv.host, srv.port, samples=2,
+                               frequency_sec=1000.0, timeout=0.5)
+    first = ts.offset_ms()
+    srv.close()
+    ts.frequency_sec = 0.0
+    for _ in range(3):
+        assert ts.offset_ms() == pytest.approx(first)   # stale, no raise
+        time.sleep(0.1)
+    # a NEVER-measured source against a dead server must raise loudly
+    dead = CoordinatorTimeSource("127.0.0.1", srv.port, samples=1,
+                                 timeout=0.3)
+    with pytest.raises(OSError):
+        dead.offset_ms()
+
+
+def test_time_source_provider_env(monkeypatch):
+    from deeplearning4j_tpu.parallel import timesource as m
+    monkeypatch.delenv(m.SOURCE_ENV, raising=False)
+    assert isinstance(m.get_time_source(), m.SystemClockTimeSource)
+    monkeypatch.setenv(m.SOURCE_ENV, "coordinator")
+    monkeypatch.delenv(m.SERVER_ENV, raising=False)
+    with pytest.raises(ValueError, match="requires"):
+        m.get_time_source()
+    monkeypatch.setenv(m.SERVER_ENV, "127.0.0.1:9")
+    ts = m.get_time_source()
+    assert isinstance(ts, m.CoordinatorTimeSource)
+    monkeypatch.setenv(m.SOURCE_ENV, "bogus")
+    with pytest.raises(ValueError, match="unknown"):
+        m.get_time_source()
+
+
+def test_training_stats_epoch_stamps():
+    """TrainingStats events carry offset-corrected epoch stamps from the
+    attached time source (EventStats + NTP alignment role)."""
+    from deeplearning4j_tpu.parallel.stats import TrainingStats
+    from deeplearning4j_tpu.parallel.timesource import TimeSource
+
+    class Shifted(TimeSource):
+        def current_time_millis(self):
+            return int(time.time() * 1000) + 5_000_000
+
+    st = TrainingStats(time_source=Shifted())
+    with st.time("step"):
+        pass
+    ev = st.events()
+    assert ev and ev[0]["key"] == "step"
+    assert ev[0]["epoch_ms"] - time.time() * 1000 > 4_000_000
+
+
+# --------------------------- LabeledPoint bridge ---------------------------
+
+def test_labeled_points_dense_sparse_and_fit():
+    from deeplearning4j_tpu.datasets import (LabeledPoint,
+                                             LabeledPointDataSetIterator,
+                                             labeled_points_to_dataset)
+    dense = LabeledPoint(1.0, np.array([1.0, 0.0, 2.0], np.float32))
+    sparse = LabeledPoint(0.0, ([0, 2], [1.0, 2.0], 3))
+    np.testing.assert_array_equal(dense.dense(), sparse.dense())
+
+    ds = labeled_points_to_dataset([dense, sparse], n_classes=2)
+    assert ds.features.shape == (2, 3)
+    np.testing.assert_array_equal(ds.labels,
+                                  [[0.0, 1.0], [1.0, 0.0]])
+    # regression mode: raw targets [N, 1]
+    dsr = labeled_points_to_dataset([dense, sparse])
+    np.testing.assert_array_equal(dsr.labels, [[1.0], [0.0]])
+
+    # the fit(RDD<LabeledPoint>) path: iterator feeds a normal network
+    r = np.random.default_rng(0)
+    pts = []
+    for i in range(64):
+        c = int(r.integers(0, 2))
+        pts.append(LabeledPoint(c, (r.normal(size=3) + 2 * c)
+                                .astype(np.float32)))
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    it = LabeledPointDataSetIterator(pts, batch_size=16, n_classes=2)
+    m.fit(it, epochs=20)
+    assert m.evaluate(it).accuracy() > 0.9
+
+    with pytest.raises(ValueError, match="outside"):
+        labeled_points_to_dataset([LabeledPoint(5.0, np.zeros(2))],
+                                  n_classes=2)
+    # MLlib SparseVector contract: negative/oob indices fail fast (numpy
+    # wrap-around would silently shuffle features)
+    with pytest.raises(ValueError, match="sparse indices"):
+        LabeledPoint(1.0, ([-1], [5.0], 3)).dense()
+    with pytest.raises(ValueError, match="sparse indices"):
+        LabeledPoint(1.0, ([3], [5.0], 3)).dense()
