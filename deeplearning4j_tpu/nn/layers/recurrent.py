@@ -115,6 +115,27 @@ class GravesLSTM(BaseRecurrentLayer):
         return (jnp.zeros((batch, self.n_out), dtype),
                 jnp.zeros((batch, self.n_out), dtype))
 
+    def _helper(self, x, mask) -> bool:
+        """Select the fused Pallas sequence kernel (cuDNN-RNN-helper
+        probing pattern): TPU backend, no mask, canonical sigmoid/tanh
+        activations, working set fits VMEM (kernels/lstm.py)."""
+        if mask is not None:
+            return False
+        if self.gate_activation != "sigmoid" or \
+                (self.activation or "tanh") != "tanh":
+            return False
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return False
+        # pallas_supported honors the DL4J_TPU_DISABLE_PALLAS kill switch
+        # and requires the TPU backend; CPU CI uses the scan path (the
+        # kernel has its own interpret-mode tests in tests/test_kernels.py)
+        from ...kernels import pallas_supported
+        if not pallas_supported():
+            return False
+        from ...kernels.lstm import lstm_fits_vmem
+        n_in = x.shape[-1]
+        return lstm_fits_vmem(n_in, self.n_out, x.shape[0])
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None,
               carry=None, return_carry=False):
         from .. import activations
@@ -130,12 +151,22 @@ class GravesLSTM(BaseRecurrentLayer):
         # the reference's forgetGateBiasInit semantics
         offs = self.forget_gate_bias_init
 
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+        if self._helper(x, mask):
+            from ...kernels.lstm import fused_lstm_sequence
+            hs, hT, cT = fused_lstm_sequence(
+                xs, params["W"], params["b"], params["peep"],
+                carry[0], carry[1], float(offs), False)
+            y = jnp.swapaxes(hs, 0, 1)
+            if return_carry:
+                return (y, (hT, cT)), state
+            return y, state
+
         def step(c, inp):
             x_t, m_t = inp
             return _lstm_cell(params["W"], params["b"], params["peep"],
                               self.n_out, c, x_t, m_t, offs, gate_act, cell_act)
 
-        xs = jnp.swapaxes(x, 0, 1)  # [T, B, F]
         ms = None if mask is None else jnp.swapaxes(
             mask.astype(x.dtype), 0, 1)
         if ms is None:

@@ -217,3 +217,88 @@ def test_flash_attention_bwd_causal_ragged():
     for a, b in zip(gk, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+# ----------------------- fused LSTM sequence kernel -----------------------
+
+def _lstm_scan_oracle(x, W, b, pp, h0, c0, offs=1.0):
+    """The layer's lax.scan cell math (nn/layers/recurrent._lstm_cell
+    semantics) as the kernel oracle."""
+    from jax import lax
+    p_i, p_f, p_o = jnp.split(pp, 3)
+
+    def step(carry, x_t):
+        h_prev, c_prev = carry
+        gates = jnp.concatenate([x_t, h_prev], -1) @ W + b
+        i_g, f_g, o_g, g_g = jnp.split(gates, 4, -1)
+        i = jax.nn.sigmoid(i_g + c_prev * p_i)
+        f = jax.nn.sigmoid(f_g + c_prev * p_f + offs)
+        g = jnp.tanh(g_g)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(o_g + c * p_o)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), hs = lax.scan(step, (h0, c0), x)
+    return hs, hT, cT
+
+
+def _lstm_args(T=6, B=3, F=5, H=6, seed=0):
+    r = np.random.default_rng(seed)
+    return (jnp.asarray(r.normal(size=(T, B, F)).astype(np.float32)),
+            jnp.asarray(r.normal(size=(F + H, 4 * H)).astype(np.float32)) * 0.3,
+            jnp.asarray(r.normal(size=(4 * H,)).astype(np.float32)) * 0.1,
+            jnp.asarray(r.normal(size=(3 * H,)).astype(np.float32)) * 0.1,
+            jnp.asarray(r.normal(size=(B, H)).astype(np.float32)) * 0.5,
+            jnp.asarray(r.normal(size=(B, H)).astype(np.float32)) * 0.5)
+
+
+def test_fused_lstm_forward_matches_scan():
+    from deeplearning4j_tpu.kernels.lstm import fused_lstm_sequence
+    args = _lstm_args()
+    hs0, hT0, cT0 = _lstm_scan_oracle(*args)
+    hs1, hT1, cT1 = fused_lstm_sequence(*args, 1.0, True)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs0),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT1), np.asarray(cT0),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("T", [1, 2, 7])
+def test_fused_lstm_grads_match_scan(T):
+    """All six gradients (x, W, b, peep, h0, c0) through every cotangent
+    path (hs, h_T, c_T) against jax.grad of the scan oracle."""
+    from deeplearning4j_tpu.kernels.lstm import fused_lstm_sequence
+    args = _lstm_args(T=T)
+    r = np.random.default_rng(1)
+    B, H = args[4].shape
+    ws = jnp.asarray(r.normal(size=(T, B, H)).astype(np.float32))
+    wt = jnp.asarray(r.normal(size=(B, H)).astype(np.float32))
+    wc = jnp.asarray(r.normal(size=(B, H)).astype(np.float32))
+
+    def mix(outs):
+        hs, hT, cT = outs
+        return jnp.sum(hs * ws) + jnp.sum(hT * wt) + jnp.sum(cT * wc)
+
+    g0 = jax.grad(lambda a: mix(_lstm_scan_oracle(*a)))(args)
+    g1 = jax.grad(lambda a: mix(fused_lstm_sequence(*a, 1.0, True)))(args)
+    for name, a, b in zip(("dx", "dW", "db", "dpeep", "dh0", "dc0"), g0, g1):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_fused_lstm_probe_conditions():
+    """Helper selection (cuDNN-RNN probing pattern): only on TPU, only
+    mask-free sigmoid/tanh, only VMEM-feasible sizes."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.kernels.lstm import lstm_fits_vmem
+    layer = GravesLSTM(n_out=8)
+    x = jnp.zeros((2, 4, 5), jnp.float32)
+    # CPU backend (tests force cpu): probe must decline — the scan path
+    # is the CI path; the kernel is exercised via interpret above
+    assert layer._helper(x, None) is False
+    assert layer._helper(x, jnp.ones((2, 4))) is False
+    assert GravesLSTM(n_out=8, gate_activation="hardsigmoid") \
+        ._helper(x, None) is False
+    assert lstm_fits_vmem(77, 200, 64)          # char-RNN size fits
+    assert not lstm_fits_vmem(4096, 4096, 256)  # too big for VMEM
