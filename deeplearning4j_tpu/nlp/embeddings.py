@@ -295,6 +295,10 @@ def make_skipgram_corpus_runner(table: InMemoryLookupTable, window: int):
     @jax.jit
     def run(syn0, syn1neg, corpus, sid, positions, lrs, rng):
         n = corpus.shape[0]
+        # NOTE: window gathers stay INSIDE the scan on purpose — an
+        # epoch-wide hoist was measured perf-NEUTRAL (the per-step gathers
+        # already overlap MXU work) but materializes O(corpus x 2W)
+        # device arrays, which would OOM large corpora.
 
         def body(carry, inp):
             s0, s1n = carry

@@ -344,7 +344,20 @@ class ComputationGraph:
                 upd = self._layer_updater(layer)
                 lr = self._layer_lr(layer, step)
                 updates, os = upd.update(g, os, step, lr)
-                new_params[name] = {k: p[k] - updates[k] for k in p}
+                if getattr(layer, "bias_learning_rate", None) is not None:
+                    # same bias-lr rescale as the multilayer step (updater
+                    # steps are linear in lr, so rescaling is exact)
+                    from .multilayer import _rescale_bias_updates
+                    if lr is None:
+                        eff = getattr(upd, "learning_rate", 1.0) or 1.0
+                        scale = layer.bias_learning_rate / eff
+                    else:
+                        scale = layer.bias_learning_rate / jnp.maximum(
+                            jnp.asarray(lr, jnp.float32), 1e-30)
+                    updates = _rescale_bias_updates(updates, scale)
+                # tree-wise: vertex params may be nested dicts (BiLSTM)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda a, u: a - u, p, updates)
                 new_opt[name] = os
             return new_params, new_state, new_opt, score
 
@@ -646,26 +659,22 @@ class ComputationGraph:
                    for l in jax.tree_util.tree_leaves(self.params))
 
     def params_flat(self) -> np.ndarray:
-        parts = []
-        for name in sorted(self.params):
-            p = self.params[name]
-            for k in sorted(p):
-                parts.append(np.asarray(p[k]).ravel())
+        from .multilayer import _flat_leaves
+        parts = [np.asarray(leaf).ravel()
+                 for name in sorted(self.params)
+                 for leaf in _flat_leaves(self.params[name])]
         return np.concatenate(parts) if parts else np.zeros(0, np.float32)
 
     def set_params_flat(self, vec: np.ndarray):
+        from .multilayer import _unflatten_like
         vec = np.asarray(vec)
+        to_array = lambda chunk, leaf: jnp.asarray(
+            chunk.reshape(leaf.shape), dtype=leaf.dtype)
         pos = 0
         new_params = {}
         for name in sorted(self.params):
-            p = self.params[name]
-            d = {}
-            for k in sorted(p):
-                n = int(np.prod(p[k].shape))
-                d[k] = jnp.asarray(vec[pos:pos + n].reshape(p[k].shape),
-                                   dtype=p[k].dtype)
-                pos += n
-            new_params[name] = d
+            new_params[name], pos = _unflatten_like(
+                self.params[name], vec, pos, to_array)
         self.params = new_params
 
     def clone(self) -> "ComputationGraph":

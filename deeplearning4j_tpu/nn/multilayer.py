@@ -46,6 +46,42 @@ def _split_or_none(rng, n):
     return [None] * n if rng is None else list(jax.random.split(rng, n))
 
 
+def _flat_leaves(p):
+    """Leaves of a (possibly nested) param dict in sorted-key-path order —
+    the deterministic layout params_flat/set_params_flat rely on (nested
+    trees: bidirectional LSTM {"fwd": {...}, "bwd": {...}})."""
+    if not isinstance(p, dict):
+        return [p]
+    out = []
+    for k in sorted(p):
+        out.extend(_flat_leaves(p[k]))
+    return out
+
+
+def _unflatten_like(p, vec, pos, to_array):
+    """Rebuild a param tree shaped like `p` from vec[pos:]; returns
+    (tree, new_pos)."""
+    if not isinstance(p, dict):
+        n = int(np.prod(p.shape))
+        return to_array(vec[pos:pos + n], p), pos + n
+    d = {}
+    for k in sorted(p):
+        d[k], pos = _unflatten_like(p[k], vec, pos, to_array)
+    return d, pos
+
+
+def _rescale_bias_updates(updates, scale):
+    """Scale the bias entries of a (possibly nested) per-layer update dict
+    — nested param trees (bidirectional LSTM {"fwd": ..., "bwd": ...})
+    rescale their inner biases."""
+    if not isinstance(updates, dict):
+        return updates
+    return {k: (v * scale if not isinstance(v, dict)
+                and (k == "b" or "bias" in k)
+                else _rescale_bias_updates(v, scale))
+            for k, v in updates.items()}
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -253,9 +289,11 @@ class MultiLayerNetwork:
                 else:
                     scale = layer.bias_learning_rate / jnp.maximum(
                         jnp.asarray(lr, jnp.float32), 1e-30)
-                updates = {k: (v * scale if k == "b" or "bias" in k else v)
-                           for k, v in updates.items()}
-            new_params.append({k: p[k] - updates[k] for k in p})
+                updates = _rescale_bias_updates(updates, scale)
+            # tree-wise subtract: params may be NESTED dicts (the
+            # bidirectional LSTM's {"fwd": {...}, "bwd": {...}})
+            new_params.append(jax.tree_util.tree_map(
+                lambda a, u: a - u, p, updates))
             new_opt.append(os)
         return new_params, new_opt
 
@@ -861,25 +899,21 @@ class MultiLayerNetwork:
                    for l in jax.tree_util.tree_leaves(self.params))
 
     def params_flat(self) -> np.ndarray:
-        """Deterministic flattened view (layer order, sorted keys) — the
-        analog of the reference's single contiguous params buffer."""
-        parts = []
-        for p in self.params:
-            for k in sorted(p):
-                parts.append(np.asarray(p[k]).ravel())
+        """Deterministic flattened view (layer order, sorted key paths;
+        nested trees like BiLSTM's included) — the analog of the
+        reference's single contiguous params buffer."""
+        parts = [np.asarray(leaf).ravel()
+                 for p in self.params for leaf in _flat_leaves(p)]
         return np.concatenate(parts) if parts else np.zeros(0, np.float32)
 
     def set_params_flat(self, vec: np.ndarray):
         vec = np.asarray(vec)
+        to_array = lambda chunk, leaf: jnp.asarray(
+            chunk.reshape(leaf.shape), dtype=leaf.dtype)
         pos = 0
         new_params = []
         for p in self.params:
-            d = {}
-            for k in sorted(p):
-                n = int(np.prod(p[k].shape))
-                d[k] = jnp.asarray(vec[pos:pos + n].reshape(p[k].shape),
-                                   dtype=p[k].dtype)
-                pos += n
+            d, pos = _unflatten_like(p, vec, pos, to_array)
             new_params.append(d)
         self.params = tuple(new_params)
 

@@ -725,7 +725,18 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     u = m._layer_updater(layer)
                     lr = m._layer_lr(layer, step)
                     updates, new_o[n] = u.update(g, opt[n], step, lr)
-                    new_p[n] = {k: p[k] - updates[k] for k in p}
+                    if getattr(layer, "bias_learning_rate", None) is not None:
+                        from ..nn.multilayer import _rescale_bias_updates
+                        if lr is None:
+                            eff = getattr(u, "learning_rate", 1.0) or 1.0
+                            scale = layer.bias_learning_rate / eff
+                        else:
+                            scale = layer.bias_learning_rate / jnp.maximum(
+                                jnp.asarray(lr, jnp.float32), 1e-30)
+                        updates = _rescale_bias_updates(updates, scale)
+                    # tree-wise: vertex params may be nested (BiLSTM)
+                    new_p[n] = jax.tree_util.tree_map(
+                        lambda a, u_: a - u_, p, updates)
                 return new_p, new_o
             jits.append(jax.jit(upd))
         return jits

@@ -206,6 +206,104 @@ def test_remat_mask_fallback_warns():
         m.fit(DataSet(x, y, features_mask=fm))
 
 
+def test_bilstm_training_updates_nested_params():
+    """Latent since round 1 (found in round 4): BiLSTM params are NESTED
+    dicts ({"fwd": {...}, "bwd": {...}}) and every update site assumed
+    flat per-layer dicts — `fit()` crashed with dict-minus-dict. Only
+    gradchecks (which bypass the updater) covered BiLSTM before. Trains
+    in both model families and the params actually move."""
+    from deeplearning4j_tpu.nn.layers import (GravesBidirectionalLSTM,
+                                              RnnOutputLayer)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(4, 7, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, (4, 7))]
+    ds = DataSet(x, y)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list()
+            .layer(GravesBidirectionalLSTM(n_out=6, activation="tanh",
+                                           bias_learning_rate=0.05))
+            .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.recurrent(5, 7))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    w0 = np.asarray(m.params[0]["fwd"]["W"]).copy()
+    for _ in range(2):
+        m.fit(ds)
+    assert np.isfinite(m.score())
+    assert np.abs(np.asarray(m.params[0]["fwd"]["W"]) - w0).max() > 0
+
+    b = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(5, 7)))
+    b.add_layer("bi", GravesBidirectionalLSTM(n_out=6, activation="tanh"),
+                "in")
+    b.add_layer("out", RnnOutputLayer(n_out=3, loss="mcxent"), "bi")
+    b.set_outputs("out")
+    g = ComputationGraph(b.build()).init()
+    gw0 = np.asarray(g.params["bi"]["bwd"]["W"]).copy()
+    g.fit(ds)
+    assert np.isfinite(g.score())
+    assert np.abs(np.asarray(g.params["bi"]["bwd"]["W"]) - gw0).max() > 0
+
+    # flat-view round-trip covers nested trees too (params_flat silently
+    # built an OBJECT array before; set_params_flat crashed)
+    v = m.params_flat()
+    assert v.dtype == np.float32 and v.ndim == 1
+    m2 = MultiLayerNetwork(conf).init()
+    m2.set_params_flat(v)
+    np.testing.assert_array_equal(m2.params_flat(), v)
+    gv = g.params_flat()
+    assert gv.dtype == np.float32
+    g.set_params_flat(gv)
+    np.testing.assert_array_equal(g.params_flat(), gv)
+
+
+def test_graph_bias_learning_rate_matches_multilayer():
+    """bias_learning_rate was honored by MultiLayerNetwork but silently
+    ignored by ComputationGraph (review finding): identical single-layer
+    configs must produce identical params after a step in both families."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[r.integers(0, 2, 8)]
+    ds = DataSet(x, y)
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=5, activation="tanh",
+                              bias_learning_rate=0.01))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+
+    gb = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+          .graph_builder().add_inputs("in")
+          .set_input_types(InputType.feed_forward(4)))
+    gb.add_layer("d", DenseLayer(n_out=5, activation="tanh",
+                                 bias_learning_rate=0.01), "in")
+    gb.add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "d")
+    gb.set_outputs("out")
+    g = ComputationGraph(gb.build()).init()
+    # identical starting point (init RNG derivations differ by design)
+    g.params = {"d": {k: np.asarray(v) for k, v in m.params[0].items()},
+                "out": {k: np.asarray(v) for k, v in m.params[1].items()}}
+    for _ in range(3):
+        m.fit(ds)
+        g.fit(ds)
+    np.testing.assert_allclose(np.asarray(g.params["d"]["W"]),
+                               np.asarray(m.params[0]["W"]),
+                               rtol=2e-6, atol=2e-7)
+    np.testing.assert_allclose(np.asarray(g.params["d"]["b"]),
+                               np.asarray(m.params[0]["b"]),
+                               rtol=2e-6, atol=2e-7)
+
+
 def test_adam_state_dtype():
     import jax.numpy as jnp
     from deeplearning4j_tpu.nn.updaters import Adam
