@@ -69,6 +69,48 @@ def main():
     np.save(f"{outdir}/params_export_p{pid}.npy", flat2)
     print(f"proc {pid} export-plane done score={trainer2.score():.6f}")
 
+    # --- cross-node time source (NTPTimeSource analog) across the REAL
+    # process boundary: proc 0 hosts the reference clock; proc 1 aligns
+    # its stats stamps through the NTP exchange --------------------------
+    import json as _json
+    import time as _time
+
+    from deeplearning4j_tpu.parallel.stats import TrainingStats
+    from deeplearning4j_tpu.parallel.timesource import (CoordinatorTimeSource,
+                                                        TimeServer)
+
+    if pid == 0:
+        srv = TimeServer()
+        with open(f"{outdir}/timeserver.json.tmp", "w") as f:
+            _json.dump({"host": srv.host, "port": srv.port}, f)
+        import os as _os
+        _os.replace(f"{outdir}/timeserver.json.tmp",
+                    f"{outdir}/timeserver.json")
+        ts_stats = TrainingStats()           # proc 0 IS the reference
+        with ts_stats.time("step"):
+            _time.sleep(0.01)
+        with open(f"{outdir}/stats_p0.json", "w") as f:
+            _json.dump(ts_stats.events(), f)
+        _time.sleep(3.0)                     # keep serving for proc 1
+        srv.close()
+    else:
+        for _ in range(200):
+            try:
+                with open(f"{outdir}/timeserver.json") as f:
+                    info = _json.load(f)
+                break
+            except (OSError, ValueError):
+                _time.sleep(0.02)
+        src = CoordinatorTimeSource(info["host"], info["port"], samples=4)
+        off = src.offset_ms()
+        assert abs(off) < 200, f"same-host offset should be ~0, got {off}"
+        ts_stats = TrainingStats(time_source=src)
+        with ts_stats.time("step"):
+            _time.sleep(0.01)
+        with open(f"{outdir}/stats_p1.json", "w") as f:
+            _json.dump(ts_stats.events(), f)
+    print(f"proc {pid} time-source done")
+
 
 if __name__ == "__main__":
     main()

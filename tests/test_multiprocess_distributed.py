@@ -75,3 +75,14 @@ def test_two_process_training_matches_single_process(tmp_path):
     e1 = np.load(tmp_path / "params_export_p1.npy")
     np.testing.assert_allclose(e0, e1, rtol=0, atol=0)
     np.testing.assert_allclose(e0, p0, rtol=0, atol=0)
+
+    # time-source tier crossed the process boundary: both processes
+    # produced offset-corrected stamps on one timeline (same host here,
+    # so the stamps must agree within the run's duration)
+    import json
+    with open(tmp_path / "stats_p0.json") as f:
+        ev0 = json.load(f)
+    with open(tmp_path / "stats_p1.json") as f:
+        ev1 = json.load(f)
+    assert ev0 and ev1
+    assert abs(ev0[0]["epoch_ms"] - ev1[0]["epoch_ms"]) < 60_000
