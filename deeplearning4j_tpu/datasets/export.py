@@ -124,6 +124,13 @@ class PathDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self.seed = seed
         self.start_from = int(start_from)
+        if self.start_from and shuffle and seed is None:
+            # resume skips `start_from` positions of a permutation the
+            # interrupted run can't have recorded — the resumed run would
+            # process a different file subset than the one actually left
+            raise ValueError(
+                "start_from with shuffle=True needs a seed: an unseeded "
+                "permutation cannot reproduce the interrupted run's order")
         self._epoch = 0
         self._started = False   # no batch consumed yet
         self.reset()
@@ -137,19 +144,23 @@ class PathDataSetIterator(DataSetIterator):
         return cls([os.path.join(directory, n) for n in names], **kw)
 
     def reset(self):
+        # the epoch counter advances only once consumption has started:
+        # however many resets precede the first batch (__init__ does one,
+        # __iter__ may do another), the first traversal's permutation is a
+        # function of `seed` ALONE — so a resumed run (start_from > 0)
+        # skips exactly the files the interrupted run consumed
+        if self._started:
+            self._epoch += 1
         order = np.arange(len(self.paths))
         if self.shuffle:
             rng = np.random.default_rng(
                 None if self.seed is None else self.seed + self._epoch)
             order = rng.permutation(len(self.paths))
         # only the FIRST traversal resumes mid-way; once a batch has been
-        # consumed, reset() means a fresh full epoch (the iterator
-        # protocol's __iter__ calls reset before iterating, so the offset
-        # must survive resets that happen before any consumption)
+        # consumed, reset() means a fresh full epoch
         offset = 0 if self._started else self.start_from
         self._order = order[offset:]
         self._pos = 0
-        self._epoch += 1
 
     def has_next(self) -> bool:
         return self._pos < len(self._order)

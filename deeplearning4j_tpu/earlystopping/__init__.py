@@ -1,6 +1,7 @@
 from .earlystopping import (
     EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
-    EarlyStoppingGraphTrainer, DataSetLossCalculator, InMemoryModelSaver,
+    EarlyStoppingGraphTrainer, EarlyStoppingParallelTrainer,
+    DataSetLossCalculator, InMemoryModelSaver,
     LocalFileModelSaver, MaxEpochsTerminationCondition,
     ScoreImprovementEpochTerminationCondition,
     BestScoreEpochTerminationCondition, MaxScoreIterationTerminationCondition,
@@ -11,6 +12,7 @@ from .earlystopping import (
 __all__ = [
     "EarlyStoppingConfiguration", "EarlyStoppingResult",
     "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+    "EarlyStoppingParallelTrainer",
     "DataSetLossCalculator", "InMemoryModelSaver", "LocalFileModelSaver",
     "MaxEpochsTerminationCondition",
     "ScoreImprovementEpochTerminationCondition",

@@ -19,6 +19,7 @@ from typing import List, Optional
 __all__ = [
     "EarlyStoppingConfiguration", "EarlyStoppingResult",
     "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+    "EarlyStoppingParallelTrainer",
     "DataSetLossCalculator", "InMemoryModelSaver", "LocalFileModelSaver",
     "MaxEpochsTerminationCondition",
     "ScoreImprovementEpochTerminationCondition",
@@ -219,6 +220,16 @@ class EarlyStoppingTrainer:
         self.model = model
         self.train_iter = train_iter
 
+    def _model_for_saving(self):
+        """The object handed to the model saver (overridden by the parallel
+        trainer, whose `self.model` is a ParallelTrainer)."""
+        return self.model
+
+    def _fit_one(self, ds):
+        """Train on one minibatch (overridden by the parallel trainer to
+        skip the per-call param publish)."""
+        self.model.fit(ds)
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         result = EarlyStoppingResult()
@@ -229,7 +240,7 @@ class EarlyStoppingTrainer:
             # one epoch, with iteration-level termination checks
             self.train_iter.reset()
             while self.train_iter.has_next():
-                self.model.fit(self.train_iter.next())
+                self._fit_one(self.train_iter.next())
                 score = self.model.score()
                 for cond in cfg.iteration_termination_conditions:
                     if cond.terminate(self.model.iteration_count, score):
@@ -248,9 +259,11 @@ class EarlyStoppingTrainer:
                 if score < result.best_model_score:
                     result.best_model_score = score
                     result.best_model_epoch = epoch
-                    cfg.model_saver.save_best_model(self.model, score)
+                    cfg.model_saver.save_best_model(
+                        self._model_for_saving(), score)
                 if cfg.save_last_model:
-                    cfg.model_saver.save_latest_model(self.model, score)
+                    cfg.model_saver.save_latest_model(
+                        self._model_for_saving(), score)
                 for cond in cfg.epoch_termination_conditions:
                     if cond.terminate(epoch, score):
                         reason = "EpochTerminationCondition"
@@ -267,3 +280,59 @@ class EarlyStoppingTrainer:
 
 # Graph models share the same trainer logic (both expose fit/score/clone)
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping driving a multi-device ParallelTrainer — reference
+    `deeplearning4j-scaleout-parallelwrapper/src/main/java/org/deeplearning4j/
+    parallelism/EarlyStoppingParallelTrainer.java:1` (there: a
+    ParallelWrapper with an AveragingIterationListener feeding the early-
+    stopping loop; here the ParallelTrainer IS the model-like — `fit` runs
+    the sharded step over the mesh, `score(ds)` computes validation scores
+    mesh-wide, and every epoch/iteration termination condition and the
+    best-model save/restore path work unchanged).
+
+    Pass a ParallelTrainer via `trainer`, or a model plus ParallelTrainer
+    kwargs (mesh/mode/strategy/...) to build one.
+    """
+
+    def __init__(self, config: EarlyStoppingConfiguration, model=None,
+                 train_iter=None, trainer=None, **trainer_kwargs):
+        if trainer is None:
+            if model is None:
+                raise ValueError("need a model or a ParallelTrainer")
+            from ..parallel.trainer import ParallelTrainer
+            trainer = ParallelTrainer(model, **trainer_kwargs)
+        super().__init__(config, trainer, train_iter)
+        self.trainer = trainer
+
+    def _fit_one(self, ds):
+        # drive the sharded step directly: ParallelTrainer.fit() would
+        # _sync_back after every minibatch, and in AVERAGING mode
+        # _sync_back averages the replicas — collapsing the local-SGD
+        # window that averaging_frequency is supposed to control
+        # (review r5); scoring/saving don't need the publish either
+        # (score(ds) reads the device arrays, _model_for_saving syncs)
+        if self.trainer._pipe is not None:
+            self.trainer.fit(ds)
+        else:
+            self.trainer._fit_batch(ds)
+
+    def _model_for_saving(self):
+        from ..parallel.trainer import TrainingMode
+        tr = self.trainer
+        if tr._pipe is not None or tr.mode == TrainingMode.SYNC:
+            # publish the mesh params into the wrapped model, save that
+            tr._sync_back()
+            return tr.model
+        # AVERAGING: publish the averaged VIEW without collapsing the live
+        # replicas (tr._sync_back would average them in place, perturbing
+        # the local-SGD training that continues after the save)
+        import jax as _jax
+        tmap = _jax.tree_util.tree_map
+        params, state = tr._eval_params_state()
+        tr.model.params = params
+        tr.model.state = state
+        tr.model.updater_state = tmap(lambda a: a.mean(0), tr._opt)
+        tr.model.iteration_count = tr.iteration_count
+        return tr.model

@@ -273,6 +273,15 @@ class ComputationGraph:
             masks.update({n: None for n in res})
             new_state.update(ns)
 
+    def _reg_score(self, params):
+        """Full-network l1/l2 penalty (MultiLayerNetwork._reg_score
+        counterpart — single source for every scoring path)."""
+        reg = jnp.float32(0.0)
+        for name, p in params.items():
+            if p:
+                reg = reg + self.conf.vertices[name].reg_score(p)
+        return reg
+
     def _loss_fn(self, params, state, inputs, labels, rng, fmasks=None,
                  lmasks=None, train=True):
         """labels: dict {output_name: labels}; lmasks likewise."""
@@ -296,11 +305,7 @@ class ComputationGraph:
             total = total + v.loss_score(params[name], state[name], x,
                                          labels[name], train=train,
                                          rng=out_rng, mask=eff)
-        reg = jnp.float32(0.0)
-        for name, p in params.items():
-            if p:
-                reg = reg + self.conf.vertices[name].reg_score(p)
-        score = total + reg / batch
+        score = total + self._reg_score(params) / batch
         # layer auxiliary losses (MoE router load balancing) — train only
         if train:
             for name, s in new_state.items():
@@ -382,13 +387,19 @@ class ComputationGraph:
         return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _predict_fn(self):
+    def predict_fn(self):
+        """Raw (unjitted) pure inference step — for callers that jit it
+        themselves with custom shardings (distributed evaluation plane)."""
         def predict(params, state, inputs, fmasks):
             values, masks, _ = self._forward_values(
                 params, state, inputs, False, None, fmasks,
                 stop_at_outputs=True)
             return self._collect_outputs(params, state, values)
-        return jax.jit(predict)
+        return predict
+
+    @functools.cached_property
+    def _predict_fn(self):
+        return jax.jit(self.predict_fn)
 
     def _collect_outputs(self, params, state, values):
         """Activate the network outputs from forward values (shared by the
@@ -632,6 +643,55 @@ class ComputationGraph:
         inputs, labels, fmasks, lmasks = self._to_inputs(ds)
         return float(self._score_fn(self.params, self.state, inputs, labels,
                                     fmasks, lmasks))
+
+    @functools.cached_property
+    def score_examples_fn(self):
+        """Raw per-example scoring step — jitted by callers (see
+        _score_examples_fn and the ParallelTrainer scoring plane)."""
+        def per_example(params, state, inputs, labels, fmasks, lmasks,
+                        add_reg):
+            values, masks, _ = self._forward_values(
+                params, state, inputs, False, None, fmasks,
+                stop_at_outputs=True)
+            per = None
+            for name in self.conf.network_outputs:
+                v = self.conf.vertices[name]
+                x, m = values[name]
+                lm = (lmasks or {}).get(name)
+                eff = lm if lm is not None else m
+                contrib = v.loss_per_example(params[name], state[name], x,
+                                             labels[name], mask=eff)
+                per = contrib if per is None else per + contrib
+            if add_reg:
+                per = per + self._reg_score(params)
+            return per
+        return per_example
+
+    @functools.cached_property
+    def _score_examples_fn(self):
+        return jax.jit(self.score_examples_fn, static_argnums=(6,))
+
+    def score_examples(self, data, add_regularization_terms: bool = True
+                       ) -> np.ndarray:
+        """Per-example scores summed over all output layers — reference
+        `ComputationGraph.scoreExamples` (ComputationGraph.java; the map
+        half of Spark's `ScoreExamplesFunction.java:1`). Accepts DataSet /
+        MultiDataSet or an iterator thereof."""
+        if self.params is None:
+            self.init()
+        if not isinstance(data, (DataSet, MultiDataSet)):
+            data.reset()
+            outs = []
+            while data.has_next():
+                outs.append(self.score_examples(data.next(),
+                                                add_regularization_terms))
+            return (np.concatenate(outs) if outs
+                    else np.zeros(0, np.float32))
+        inputs, labels, fmasks, lmasks = self._to_inputs(data)
+        per = self._score_examples_fn(self.params, self.state, inputs,
+                                      labels, fmasks, lmasks,
+                                      bool(add_regularization_terms))
+        return np.asarray(per)
 
     def evaluate(self, iterator, labels_list=None, top_n: int = 1) -> Evaluation:
         ev = Evaluation(labels=labels_list, top_n=top_n)

@@ -91,6 +91,27 @@ class BaseOutputLayerConf(LayerConf):
         return self.loss_fn().score(labels, z, activation=self.activation,
                                     mask=mask, weights=self.loss_weights)
 
+    def loss_per_example(self, params, state, x, labels, *, mask=None):
+        """Unreduced per-example loss [batch] — the map half of the
+        distributed scoring plane (reference
+        `BaseOutputLayer.computeScoreForExamples`, BaseOutputLayer.java:117:
+        masked per-element score array; time-series scores are SUMMED over
+        time per example, RnnOutputLayer.java:219-233)."""
+        import jax.numpy as jnp
+
+        z = self.preout(params, state, x, train=False, rng=None, mask=mask)
+        per = self.loss_fn().per_example(labels, z,
+                                         activation=self.activation,
+                                         weights=self.loss_weights)
+        if mask is not None:
+            m = mask.astype(per.dtype)
+            m = jnp.broadcast_to(
+                m.reshape(m.shape + (1,) * (per.ndim - m.ndim)), per.shape)
+            per = per * m
+        while per.ndim > 1:   # [B, T] (RNN) -> sum over time
+            per = per.sum(axis=-1)
+        return per
+
 
 @register_layer
 @dataclass

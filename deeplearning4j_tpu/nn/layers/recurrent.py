@@ -134,7 +134,13 @@ class GravesLSTM(BaseRecurrentLayer):
             return False
         from ...kernels.lstm import lstm_fits_vmem
         n_in = x.shape[-1]
-        return lstm_fits_vmem(n_in, self.n_out, x.shape[0])
+        # the kernel canonicalizes to f32 internally, but f64 params stay
+        # f64 inside it — size the feasibility check by what the kernel
+        # will actually allocate (review finding r4: a hardcoded 4 was 2x
+        # optimistic for f64 at large H)
+        dtype_bytes = max(4, jnp.dtype(x.dtype).itemsize)
+        return lstm_fits_vmem(n_in, self.n_out, x.shape[0],
+                              dtype_bytes=dtype_bytes)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None,
               carry=None, return_carry=False):

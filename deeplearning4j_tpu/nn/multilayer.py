@@ -343,12 +343,18 @@ class MultiLayerNetwork:
         return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _predict_fn(self):
+    def predict_fn(self):
+        """Raw (unjitted) pure inference step — for callers that jit it
+        themselves with custom shardings (distributed evaluation plane)."""
         def predict(params, state, x, fmask):
             out, _, _, _ = self._forward(params, state, x, False, None,
                                          fmask=fmask)
             return out
-        return jax.jit(predict)
+        return predict
+
+    @functools.cached_property
+    def _predict_fn(self):
+        return jax.jit(self.predict_fn)
 
     @functools.cached_property
     def _tbptt_step(self):
@@ -875,6 +881,98 @@ class MultiLayerNetwork:
             out = self.output(ds.features, features_mask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
         return ev
+
+    @functools.cached_property
+    def score_examples_fn(self):
+        """Raw per-example scoring step (params, state, x, y, fmask, lmask,
+        add_reg) -> [batch] — jitted by callers (see _score_examples_fn and
+        the ParallelTrainer scoring plane)."""
+        def per_example(params, state, x, y, fmask, lmask, add_reg):
+            out_layer = self.layers[-1]
+            n = len(self.layers)
+            h, _, mask, _ = self._forward(params, state, x, False, None,
+                                          fmask=fmask, upto=n - 1)
+            if (n - 1) in self.conf.preprocessors:
+                h = self.conf.preprocessors[n - 1].apply(h)
+                mask = self.conf.preprocessors[n - 1].apply_mask(mask)
+            eff_lmask = lmask if lmask is not None else mask
+            per = out_layer.loss_per_example(params[-1], state[-1], h, y,
+                                             mask=eff_lmask)
+            if add_reg:
+                per = per + self._reg_score(params)
+            return per
+        return per_example
+
+    @functools.cached_property
+    def _score_examples_fn(self):
+        """add_reg static: at most two compiles (with/without reg terms)."""
+        return jax.jit(self.score_examples_fn, static_argnums=(6,))
+
+    def score_examples(self, data, add_regularization_terms: bool = True
+                       ) -> np.ndarray:
+        """Per-example scores (loss values), NOT averaged over the batch —
+        reference `MultiLayerNetwork.scoreExamples`
+        (MultiLayerNetwork.java:1737 for iterators, :1754 for a DataSet).
+        With `add_regularization_terms`, the full-network l1/l2 is added to
+        each example's score, so row i equals `score(DataSet)` of that
+        single example (the reference's documented equivalence). Accepts a
+        DataSet or a DataSetIterator (scores concatenated in order)."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, DataSetIterator):
+            data.reset()
+            outs = []
+            while data.has_next():
+                outs.append(self.score_examples(data.next(),
+                                                add_regularization_terms))
+            return (np.concatenate(outs) if outs
+                    else np.zeros(0, np.float32))
+        if not isinstance(data, DataSet):
+            raise TypeError(f"score_examples needs DataSet/iterator, got "
+                            f"{type(data)}")
+        fm = (None if data.features_mask is None
+              else jnp.asarray(data.features_mask))
+        lm = (None if data.labels_mask is None
+              else jnp.asarray(data.labels_mask))
+        per = self._score_examples_fn(self.params, self.state,
+                                      jnp.asarray(data.features),
+                                      jnp.asarray(data.labels), fm, lm,
+                                      bool(add_regularization_terms))
+        return np.asarray(per)
+
+    def reconstruction_log_probability(self, x, num_samples: int = 5,
+                                       seed: int = 0) -> np.ndarray:
+        """Per-example importance-sampled reconstruction log-probability of a
+        leading VariationalAutoencoder layer — the scoring quantity behind
+        the reference's VAE anomaly-detection plane
+        (`variational/VariationalAutoencoder.reconstructionLogProbability`,
+        used by Spark's
+        `BaseVaeReconstructionProbWithKeyFunctionAdapter.java:1`). The seed
+        is explicit so distributed captures are reproducible."""
+        from .layers.generative import VariationalAutoencoder
+        if self.params is None:
+            self.init()
+        layer0 = self.layers[0]
+        if not isinstance(layer0, VariationalAutoencoder):
+            raise ValueError("reconstruction_log_probability requires the "
+                             "first layer to be a VariationalAutoencoder "
+                             f"(got {type(layer0).__name__})")
+        fn = self._recon_logp_fn
+        return np.asarray(fn(self.params[0], jnp.asarray(x),
+                             jax.random.PRNGKey(seed), num_samples))
+
+    @functools.cached_property
+    def _recon_logp_fn(self):
+        layer0 = self.layers[0]
+        return jax.jit(
+            lambda p, x, rng, n: layer0.reconstruction_probability(
+                p, x, rng, num_samples=n),
+            static_argnums=(3,))
+
+    def reconstruction_probability(self, x, num_samples: int = 5,
+                                   seed: int = 0) -> np.ndarray:
+        return np.exp(self.reconstruction_log_probability(
+            x, num_samples=num_samples, seed=seed))
 
     # ------------------------------------------------------------------
     # Introspection / param plumbing

@@ -23,7 +23,7 @@ from .mesh import MeshAxes, make_hybrid_mesh, make_mesh
 log = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["initialize", "is_multi_host", "global_mesh", "process_index",
-           "local_batch_slice"]
+           "local_batch_slice", "allreduce_evaluation", "allgather_rows"]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -75,6 +75,67 @@ def global_batch_array(mesh, local, axis: str = MeshAxes.DATA):
 
     sh = NamedSharding(mesh, P(axis))
     return jax.make_array_from_process_local_data(sh, np.asarray(local))
+
+
+def allreduce_evaluation(ev):
+    """The reduce half of the distributed evaluation plane: merge
+    per-process `Evaluation` states into one identical global Evaluation on
+    every host (reference `IEvaluationReduceFunction.java` — executors
+    evaluated RDD partitions, the driver reduced with `Evaluation.merge`).
+    Count state (confusion matrix + top-N tallies) is summed over the
+    coordinator; per-example Prediction records stay process-local, like the
+    reference's metadata which stayed in the RDD partitions."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    from ..eval.evaluation import ConfusionMatrix, Evaluation
+
+    if jax.process_count() == 1:
+        return ev
+    c_local = int(ev.num_classes or 0)
+    c = int(np.max(mhu.process_allgather(np.asarray([c_local], np.int32))))
+    mat = np.zeros((c, c), np.int64)
+    if ev.confusion is not None:
+        mat[:c_local, :c_local] = ev.confusion.matrix
+    payload = np.concatenate([
+        mat.ravel(),
+        np.asarray([ev.top_n_correct, ev.top_n_total], np.int64)])
+    total = np.asarray(mhu.process_allgather(payload)).sum(axis=0)
+    merged = Evaluation(num_classes=c or None, top_n=ev.top_n,
+                        labels=ev.label_names)
+    if c:
+        merged.confusion = ConfusionMatrix(c)
+        merged.confusion.matrix = total[:-2].reshape(c, c)
+    merged.top_n_correct = int(total[-2])
+    merged.top_n_total = int(total[-1])
+    return merged
+
+
+def allgather_rows(local):
+    """Gather variable-length per-process 1-D arrays into the global
+    concatenation (ordered by process id), identical on every host — the
+    collect half of per-example distributed scoring (reference
+    `ScoreExamplesFunction` rows lived in RDD partitions; collecting was the
+    caller's `RDD.collect`)."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    local = np.asarray(local)
+    if jax.process_count() == 1:
+        return local
+    lens = np.asarray(mhu.process_allgather(
+        np.asarray([local.shape[0]], np.int64))).ravel()
+    m = int(lens.max())
+    if m == 0:
+        return np.zeros(0, np.float64)
+    # the collective runs in float64 unconditionally: a process whose
+    # shard is EMPTY doesn't know the others' dtype, and mismatched
+    # per-process dtypes in one allgather fail deep in the runtime
+    padded = np.zeros((m,), np.float64)
+    padded[:local.shape[0]] = local
+    rows = np.asarray(mhu.process_allgather(padded))
+    return np.concatenate([rows[p, :int(lens[p])]
+                           for p in range(rows.shape[0])])
 
 
 def local_batch_slice(global_batch: int) -> slice:

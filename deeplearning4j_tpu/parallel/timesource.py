@@ -135,6 +135,11 @@ class CoordinatorTimeSource(TimeSource):
         self._measured_at = float("-inf")
         self._refreshing = False
         self._lock = threading.Lock()
+        # Measure EAGERLY: an unreachable server fails here, at
+        # construction, where it is unambiguously a configuration error —
+        # not on the first stats.time() inside the training loop (which is
+        # designed never to crash; review finding r4)
+        self._refresh()
 
     # -- NTP exchange ----------------------------------------------------
     def _measure_once(self, sock) -> Tuple[float, float]:
@@ -163,16 +168,15 @@ class CoordinatorTimeSource(TimeSource):
         self._measured_at = self._clock()
 
     def offset_ms(self) -> float:
-        """Current offset. The FIRST measurement is synchronous (no offset
-        exists yet — a failure here raises, like NTPTimeSource's
-        initial-query retries). Later refreshes run on a background
-        thread while the STALE offset keeps being served, and a refresh
-        failure logs and keeps the last good value (reference behavior) —
-        a dead time server can never crash the training loop or stall
-        the stats hot path."""
+        """Current offset. The first measurement happened in __init__
+        (synchronous — a failure there is a config error and raises).
+        Refreshes run on a background thread while the STALE offset keeps
+        being served, and a refresh failure logs and keeps the last good
+        value (reference behavior) — a dead time server can never crash
+        the training loop or stall the stats hot path."""
         with self._lock:
-            if self._offset is None:
-                self._refresh()   # first ever: synchronous, errors raise
+            if self._offset is None:   # defensive; __init__ measures
+                self._refresh()
             elif (self._clock() - self._measured_at > self.frequency_sec
                     and not getattr(self, "_refreshing", False)):
                 self._refreshing = True

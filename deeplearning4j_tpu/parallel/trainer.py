@@ -47,6 +47,12 @@ class TrainingMode:
     AVERAGING = "averaging"    # local SGD, average params every N iterations
 
 
+def _to_host(tree):
+    """Host-local copy of a (fully-replicated) device pytree."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)), tree)
+
+
 class ParallelTrainer:
     """fit(iterator) over a device mesh.
 
@@ -119,6 +125,11 @@ class ParallelTrainer:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P(self.data_axis))
+        # kept for the evaluation/scoring plane (jit of predict/score fns
+        # with the same shardings as the train step)
+        self._repl = repl
+        self._batch_sh = batch_sh
+        self._p_sh = repl
         if self.mode == TrainingMode.SYNC:
             specs = param_specs(m.params, self.strategy, mesh,
                                 self.model_axis, self.data_axis)
@@ -127,6 +138,7 @@ class ParallelTrainer:
                 is_leaf=lambda x: isinstance(x, P))
             from .sharding import _opt_sharding_like
             o_sh = _opt_sharding_like(m.updater_state, m.params, p_sh)
+            self._p_sh = p_sh
             self._params = jax.device_put(m.params, p_sh)
             self._state = jax.device_put(m.state, repl)
             self._opt = jax.device_put(m.updater_state, o_sh)
@@ -310,10 +322,375 @@ class ParallelTrainer:
                             jax.tree_util.tree_leaves(self._params)[0])
         self.iteration_count += 1
 
-    def score(self) -> float:
+    def score(self, ds=None) -> float:
+        """No-arg: last minibatch training score (reference ParallelWrapper
+        behavior). With a DataSet/MultiDataSet: the scalar model score of
+        that batch computed over the mesh — the scoring half the reference
+        ran through `impl/common/score/` Spark functions; used by
+        EarlyStoppingParallelTrainer's score calculators. Multi-process:
+        the example-count-weighted mean over every process's row share
+        (for masked time-series data this weights by examples, not mask
+        entries — `DataSetLossCalculator`'s own convention)."""
+        if ds is None:
+            if self._pipe is not None:
+                return self._pipe.score()
+            return float(jnp.asarray(self._score).mean())
         if self._pipe is not None:
-            return self._pipe.score()
-        return float(jnp.asarray(self._score).mean())
+            self._pipe.sync_back()
+            return self.model.score(ds)
+        if jax.process_count() > 1:
+            # each process scores its row share; the weighted mean is
+            # allreduced so EVERY process returns the identical global
+            # value — divergent per-process scores would let an
+            # early-stopping condition fire on one host only and hang the
+            # others in the next collective
+            from jax.experimental import multihost_utils as mhu
+            sub = self._local_rows(ds)
+            params, state = self._local_params_state()
+            if sub is None:
+                part = np.zeros(2)
+            else:
+                xs, ys, fm, lm = self._to_batch(sub)
+                n = sub.num_examples()
+                s = float(self._score_raw(params, state, xs, ys, fm, lm))
+                # _score_raw folds reg/n_local into each share's scalar;
+                # strip it before re-weighting or the allreduce counts the
+                # (process-identical) reg term once PER process instead of
+                # once globally (review r5)
+                reg = self._reg_value(params)
+                part = np.asarray([(s - reg / n) * n, float(n)])
+            tot = np.asarray(mhu.process_allgather(part)).sum(axis=0)
+            n_global = max(tot[1], 1.0)
+            reg = self._reg_value(self._local_params_state()[0])
+            return float((tot[0] + reg) / n_global)
+        x, y, fm, lm = self._to_batch(ds)
+        bs = jax.tree_util.tree_leaves(x)[0].shape[0]
+        if bs % self.n_data == 0:
+            params, state = self._eval_params_state()
+            return float(self._eval_score(params, state, x, y, fm, lm))
+        # ragged batch: the scalar is a mean over REAL rows only, so the
+        # pad-and-slice trick doesn't apply — score host-local instead.
+        # Only sound with replicated params (they fit one device by
+        # definition); materializing a SHARDED model on one device could
+        # OOM the very model the sharding exists for (review r5)
+        if self.strategy != ShardingStrategy.REPLICATED:
+            raise ValueError(
+                f"score(ds) with strategy={self.strategy} needs a batch "
+                f"divisible by the data axis ({self.n_data}); got {bs}. "
+                "Pad or re-batch the validation set")
+        params, state = self._eval_params_state()
+        return float(self._score_raw(_to_host(params), _to_host(state),
+                                     x, y, fm, lm))
+
+    def _reg_value(self, params) -> float:
+        """Full-network l1/l2 penalty (identical on every process — params
+        are replicated on this path). Both model families expose
+        `_reg_score`, the same function their `_loss_fn`s fold in."""
+        return float(self.model._reg_score(params))
+
+    @functools.cached_property
+    def _score_fn_raw(self):
+        from ..nn.graph import ComputationGraph
+
+        if isinstance(self.model, ComputationGraph):
+            def f(p, s, xs, ys, fm, lm):
+                return self.model._loss_fn(p, s, xs, ys, None, fmasks=fm,
+                                           lmasks=lm, train=False)[0]
+        else:
+            def f(p, s, x, y, fm, lm):
+                return self.model._loss_fn(p, s, x, y, None, fmask=fm,
+                                           lmask=lm, train=False)[0]
+        return f
+
+    @functools.cached_property
+    def _score_raw(self):
+        return jax.jit(self._score_fn_raw)
+
+    @functools.cached_property
+    def _eval_score(self):
+        b = self._batch_sh
+        return jax.jit(self._score_fn_raw,
+                       in_shardings=(self._p_sh, self._repl, b, b, b, b),
+                       out_shardings=self._repl)
+
+    # ------------------------------------------------------------------
+    # Distributed evaluation / scoring plane.
+    #
+    # The reference evaluates and scores over the cluster:
+    # `SparkDl4jMultiLayer.evaluate(RDD)` backed by
+    # `dl4j-spark/.../impl/multilayer/evaluation/IEvaluateFlatMapFunction.java:1`
+    # (map: evaluate a partition) + `IEvaluationReduceFunction.java` (reduce:
+    # merge Evaluations), per-example scoring via
+    # `impl/common/score/ScoreExamplesFunction.java` and VAE reconstruction
+    # scoring via
+    # `impl/common/score/BaseVaeReconstructionProbWithKeyFunctionAdapter.java`.
+    #
+    # TPU-native shape: ONE jitted forward over the mesh with the batch
+    # sharded on the data axis (XLA's collectives are the shuffle); the
+    # map/reduce structure survives as per-device-shard Evaluations merged
+    # via `Evaluation.merge` (count-exact, so multi-device == single-device
+    # is an equality, not a tolerance). Across processes each host computes
+    # its local shard and the evaluation state is allreduced
+    # (`distributed.allreduce_evaluation`).
+    # ------------------------------------------------------------------
+    def _eval_params_state(self):
+        if self.mode == TrainingMode.SYNC:
+            return self._params, self._state
+        # AVERAGING: same view _sync_back publishes — params averaged over
+        # replicas, state from replica 0
+        tmap = jax.tree_util.tree_map
+        return (tmap(lambda a: a.mean(0), self._params),
+                tmap(lambda a: a[0], self._state))
+
+    @functools.cached_property
+    def _eval_predict(self):
+        return jax.jit(self.model.predict_fn,
+                       in_shardings=(self._p_sh, self._repl, self._batch_sh,
+                                     self._batch_sh),
+                       out_shardings=self._repl)
+
+    @functools.cached_property
+    def _eval_score_examples(self):
+        b = self._batch_sh
+        return jax.jit(self.model.score_examples_fn,
+                       in_shardings=(self._p_sh, self._repl, b, b, b, b),
+                       out_shardings=self._repl, static_argnums=(6,))
+
+    def _pad_to(self, tree, n_div):
+        """Zero-pad the batch axis to a multiple of the data axis so SPMD
+        shards evenly; callers slice padding off the (replicated) result.
+        Eval-mode forward is per-example (BN running stats, no dropout), so
+        padding cannot perturb real rows."""
+        tmap = jax.tree_util.tree_map
+        bs = jax.tree_util.tree_leaves(tree)[0].shape[0]
+        pad = (-bs) % n_div
+        if pad:
+            tree = tmap(lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]), tree)
+        return tree, bs
+
+    def _eval_batches(self, data):
+        """Yield DataSet/MultiDataSet batches from a dataset or iterator."""
+        if isinstance(data, (DataSet, MultiDataSet)):
+            yield data
+            return
+        data.reset()
+        while data.has_next():
+            yield data.next()
+
+    def _lockstep_batches(self, data):
+        """Multi-process batch loop for paths with per-batch collectives:
+        every round, processes agree (one tiny allgather) whether ANY of
+        them still has a batch; exhausted processes keep participating
+        with `None` until all are done. Unequal per-process batch counts
+        therefore contribute empty shares instead of desynchronizing the
+        collectives into a distributed hang (review r5)."""
+        from jax.experimental import multihost_utils as mhu
+
+        it = self._eval_batches(data)
+        while True:
+            ds = next(it, None)
+            have = np.asarray([0 if ds is None else 1], np.int32)
+            if int(np.asarray(mhu.process_allgather(have)).sum()) == 0:
+                return
+            yield ds
+
+    def _label_pairs(self, ds, outs):
+        """[(labels, out, labels_mask), ...] per network output, host-side."""
+        from ..nn.graph import ComputationGraph
+
+        if not isinstance(self.model, ComputationGraph):
+            return [(np.asarray(ds.labels), outs, ds.labels_mask)]
+        if isinstance(ds, DataSet):
+            return [(np.asarray(ds.labels), outs[0], ds.labels_mask)]
+        lmasks = ds.labels_masks or [None] * len(ds.labels)
+        return [(np.asarray(l), o, m)
+                for l, o, m in zip(ds.labels, outs, lmasks)]
+
+    def _local_rows(self, ds):
+        """This process's row share of an evaluation batch, matching fit()'s
+        interpretation of the same inputs: a LocalShardDataSet (export
+        plane) is already this process's data; a REPLICATED batch — the
+        form fit() slices with `local_batch_slice` — is split into
+        contiguous even slices so the union over processes covers every
+        row exactly once, in process order. Returns None for an empty
+        share (more processes than rows)."""
+        if getattr(ds, "is_local_shard", False):
+            return ds
+        n = ds.num_examples()
+        p, i = jax.process_count(), jax.process_index()
+        lo, hi = (i * n) // p, ((i + 1) * n) // p
+        if lo == hi:
+            return None
+        cut = lambda a: None if a is None else a[lo:hi]
+        if isinstance(ds, MultiDataSet):
+            cl = lambda xs: None if xs is None else [cut(a) for a in xs]
+            return MultiDataSet(features=cl(ds.features),
+                                labels=cl(ds.labels),
+                                features_masks=cl(ds.features_masks),
+                                labels_masks=cl(ds.labels_masks))
+        return DataSet(cut(ds.features), cut(ds.labels),
+                       cut(ds.features_mask), cut(ds.labels_mask))
+
+    def evaluate(self, data, labels_list=None, top_n: int = 1):
+        """Distributed evaluation: `SparkDl4jMultiLayer.evaluate(RDD)` /
+        `SparkComputationGraph.evaluate` analog. Accepts a DataSet or any
+        DataSetIterator — replicated data is split across processes,
+        per-process shard iterators (export plane) are used as-is — and
+        returns the merged Evaluation, identical on every process."""
+        from ..eval import Evaluation
+
+        if self._pipe is not None:
+            # stage-partitioned params live in the pipe trainer; publish and
+            # evaluate on the reassembled model
+            self._pipe.sync_back()
+            return self.model.evaluate(data, labels_list=labels_list,
+                                       top_n=top_n)
+        ev = Evaluation(labels=labels_list, top_n=top_n)
+        multi = jax.process_count() > 1
+        if multi:
+            params, state = self._local_params_state()
+        else:
+            params, state = self._eval_params_state()
+        for ds in self._eval_batches(data):
+            if multi:
+                # map side: this process evaluates only its row share,
+                # host-locally (replicated params were pulled local); the
+                # reduce is the cross-process allreduce below
+                ds = self._local_rows(ds)
+                if ds is None:
+                    continue
+                out = self._local_predict(params, state, ds)
+            else:
+                # single process: one sharded forward over the mesh; the
+                # count accumulation into `ev` is the (associative) reduce
+                x, _, fm, _ = self._to_batch(ds)
+                (x, fm), bs = self._pad_to((x, fm), self.n_data)
+                out = self._eval_predict(params, state, x, fm)
+            for labels, o, lmask in self._label_pairs(ds, out):
+                o = np.asarray(o)[:labels.shape[0]]
+                ev.eval(labels, o,
+                        mask=None if lmask is None else np.asarray(lmask))
+        if multi:
+            from .distributed import allreduce_evaluation
+            ev = allreduce_evaluation(ev)
+            ev.label_names = list(labels_list) if labels_list else None
+        return ev
+
+    def score_examples(self, data, add_regularization_terms: bool = True
+                       ) -> np.ndarray:
+        """Per-example scores over the mesh — Spark
+        `ScoreExamplesFunction.java` analog of
+        `MultiLayerNetwork.score_examples`. Multi-process: each host scores
+        its row share (shard files as-is, replicated batches split — see
+        `_local_rows`) and the rows are allgathered in process order, so
+        every process returns the identical global array with one row per
+        example."""
+        if self._pipe is not None:
+            self._pipe.sync_back()
+            return self.model.score_examples(data, add_regularization_terms)
+        multi = jax.process_count() > 1
+        outs = []
+        if multi:
+            # gather per BATCH (every process participates, empty share
+            # included) so rows come back in true example order: each
+            # batch's share slices are contiguous in process order.
+            # _lockstep_batches keeps the collectives aligned even when
+            # per-process shard iterators yield unequal batch counts
+            from .distributed import allgather_rows
+            params, state = self._local_params_state()
+            for ds in self._lockstep_batches(data):
+                sub = None if ds is None else self._local_rows(ds)
+                local = (np.zeros(0, np.float32) if sub is None else
+                         self._local_score_examples(
+                             params, state, sub, add_regularization_terms))
+                outs.append(allgather_rows(local))
+        else:
+            params, state = self._eval_params_state()
+            for ds in self._eval_batches(data):
+                x, y, fm, lm = self._to_batch(ds)
+                bs = jax.tree_util.tree_leaves(x)[0].shape[0]
+                (x, y, fm, lm), _ = self._pad_to((x, y, fm, lm), self.n_data)
+                per = self._eval_score_examples(
+                    params, state, x, y, fm, lm,
+                    bool(add_regularization_terms))
+                outs.append(np.asarray(per)[:bs])
+        return (np.concatenate(outs) if outs else np.zeros(0, np.float32))
+
+    def reconstruction_log_probability(self, data, num_samples: int = 5,
+                                       seed: int = 0) -> np.ndarray:
+        """VAE reconstruction log-probability through the same plane —
+        `BaseVaeReconstructionProbWithKeyFunctionAdapter.java:1` analog
+        (anomaly scoring over the cluster)."""
+        from ..nn.layers.generative import VariationalAutoencoder
+
+        layer0 = self.model.layers[0]
+        if not isinstance(layer0, VariationalAutoencoder):
+            raise ValueError("reconstruction_log_probability requires the "
+                             "first layer to be a VariationalAutoencoder")
+        multi = jax.process_count() > 1
+        outs = []
+        if multi:
+            from .distributed import allgather_rows
+            params, _ = self._local_params_state()
+            for ds in self._lockstep_batches(data):
+                sub = None if ds is None else self._local_rows(ds)
+                if sub is None:
+                    local = np.zeros(0, np.float32)
+                else:
+                    local = np.asarray(self.model._recon_logp_fn(
+                        params[0], jnp.asarray(sub.features),
+                        jax.random.PRNGKey(seed), num_samples))
+                outs.append(allgather_rows(local))
+        else:
+            params, _ = self._eval_params_state()
+            fn = self._eval_recon_logp
+            for ds in self._eval_batches(data):
+                x = jnp.asarray(ds.features)
+                (x,), bs = self._pad_to((x,), self.n_data)
+                outs.append(np.asarray(fn(
+                    params[0], x, jax.random.PRNGKey(seed),
+                    num_samples))[:bs])
+        return (np.concatenate(outs) if outs else np.zeros(0, np.float32))
+
+    @functools.cached_property
+    def _eval_recon_logp(self):
+        layer0 = self.model.layers[0]
+        p_sh0 = (self._p_sh[0] if isinstance(self._p_sh, (tuple, list))
+                 else self._p_sh)
+        return jax.jit(
+            lambda p, x, rng, n: layer0.reconstruction_probability(
+                p, x, rng, num_samples=n),
+            in_shardings=(p_sh0, self._batch_sh, self._repl),
+            out_shardings=self._repl, static_argnums=(3,))
+
+    # -- multi-process map side: host-local compute on the local shard -----
+    def _local_params_state(self):
+        """Host-local copy of the trained params for per-process map-side
+        evaluation (requires replicated params — every host holds the full
+        value, like every Spark executor held the broadcast params).
+        Cached per training step: a multi-batch validation pass pulls the
+        model device-to-host once, not once per batch (review r5)."""
+        if self.strategy != ShardingStrategy.REPLICATED:
+            raise ValueError(
+                "multi-process evaluate/score needs replicated params; "
+                f"strategy={self.strategy} shards them across hosts")
+        cached = getattr(self, "_host_cache", None)
+        if cached is not None and cached[0] == self.iteration_count:
+            return cached[1], cached[2]
+        params, state = self._eval_params_state()
+        params, state = _to_host(params), _to_host(state)
+        self._host_cache = (self.iteration_count, params, state)
+        return params, state
+
+    def _local_predict(self, params, state, ds):
+        x, _, fm, _ = self._to_batch(ds)
+        return self.model._predict_fn(params, state, x, fm)
+
+    def _local_score_examples(self, params, state, ds, add_reg):
+        x, y, fm, lm = self._to_batch(ds)
+        return np.asarray(self.model._score_examples_fn(
+            params, state, x, y, fm, lm, bool(add_reg)))
 
     def _sync_back(self):
         """Write averaged/replicated params back into the wrapped model."""

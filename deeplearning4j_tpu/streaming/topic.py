@@ -46,7 +46,16 @@ class FileTopic:
     Layout: `<root>/<name>/segment_<base-offset>.log` holds records
     `[8-byte big-endian length][payload]` starting at logical offset
     `<base-offset>`; `<root>/<name>/offsets/<group>.json` holds committed
-    consumer-group offsets."""
+    consumer-group offsets.
+
+    Concurrency contract (Kafka's per-partition-leader analog): any number
+    of reader processes, ONE writer at a time. Logical offsets are assigned
+    from a cursor that `append` re-syncs against the last segment's on-disk
+    length first, so sequential writer handoff (crash → restart, or another
+    process that appended since this object was opened) assigns correct
+    offsets — but two writers appending CONCURRENTLY race between the
+    re-sync and the write and can mint duplicate offsets; run one producer
+    per topic, as the reference ran one Kafka partition leader."""
 
     def __init__(self, root: str, name: str = "ndarrays",
                  segment_bytes: int = 16 << 20, fsync: bool = False):
@@ -59,6 +68,9 @@ class FileTopic:
         # first touch, extended incrementally): read(offset) seeks
         # directly instead of skipping headers from the segment base
         self._index: dict = {}
+        # path -> byte length the index covers; a mismatch with the file's
+        # real size means another writer appended (or we crashed mid-write)
+        self._indexed_bytes: dict = {}
         self._recover()
 
     # -- log structure ---------------------------------------------------
@@ -104,14 +116,24 @@ class FileTopic:
             with open(path, "r+b") as f:
                 f.truncate(valid)
         self._index[path] = offs
+        self._indexed_bytes[path] = valid
         self._end = base + len(offs)
 
     # -- producer side ---------------------------------------------------
     def append(self, payload: bytes) -> int:
         """Append one record; returns its logical offset. Durable against
         torn writes (recovery truncates); `fsync=True` makes it durable
-        against power loss too."""
+        against power loss too. Single writer at a time: see class
+        docstring."""
         segs = self._segments()
+        if segs:
+            # re-sync the offset cursor if the last segment grew (or was
+            # torn) behind our back — a previous writer's appends must not
+            # be assigned duplicate logical offsets
+            last = segs[-1][1]
+            if self._indexed_bytes.get(last) != os.path.getsize(last):
+                self._recover()
+                segs = self._segments()
         if segs and os.path.getsize(segs[-1][1]) < self.segment_bytes:
             path = segs[-1][1]
         else:
@@ -124,6 +146,7 @@ class FileTopic:
             if self.fsync:
                 os.fsync(f.fileno())
         self._index.setdefault(path, []).append(byte_off)
+        self._indexed_bytes[path] = byte_off + _LEN.size + len(payload)
         off = self._end
         self._end += 1
         return off
@@ -159,8 +182,9 @@ class FileTopic:
         base, path = seg
         offs = self._index.get(path)
         if offs is None or offset - base >= len(offs):
-            offs, _ = self._scan(path)
+            offs, valid = self._scan(path)
             self._index[path] = offs
+            self._indexed_bytes[path] = valid
             if offset - base >= len(offs):
                 return None
         with open(path, "rb") as f:

@@ -28,10 +28,12 @@ def main():
     from deeplearning4j_tpu.parallel import (ParallelTrainer, TrainingMode,
                                              make_mesh)
 
+    # l2 is on so the scoring plane's regularization handling is exercised
+    # across the process boundary (reg must be counted once globally)
     conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
             .list()
-            .layer(DenseLayer(n_out=16, activation="tanh"))
-            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .layer(DenseLayer(n_out=16, activation="tanh", l2=1e-3))
+            .layer(OutputLayer(n_out=4, loss="mcxent", l2=1e-3))
             .set_input_type(InputType.feed_forward(8))
             .build())
     model = MultiLayerNetwork(conf).init()
@@ -68,6 +70,34 @@ def main():
                             for l in jax.tree_util.tree_leaves(model2.params)])
     np.save(f"{outdir}/params_export_p{pid}.npy", flat2)
     print(f"proc {pid} export-plane done score={trainer2.score():.6f}")
+
+    # --- distributed evaluation & scoring plane across the REAL process
+    # boundary, each process reading ONLY its shard files (the
+    # IEvaluateFlatMapFunction + IEvaluationReduceFunction /
+    # ScoreExamplesFunction analogs): the merged Evaluation and the
+    # allgathered per-example scores must be identical on every process
+    # and equal to the single-process result ------------------------------
+    ev = trainer2.evaluate(ShardedPathDataSetIterator(shard_paths[pid]))
+    np.save(f"{outdir}/evalmat_p{pid}.npy", ev.confusion.matrix)
+    scores = trainer2.score_examples(
+        ShardedPathDataSetIterator(shard_paths[pid]),
+        add_regularization_terms=True)
+    np.save(f"{outdir}/scores_p{pid}.npy", scores)
+    print(f"proc {pid} eval-plane done n={ev.num_examples()}")
+
+    # replicated input — the same global DataSet every process holds (the
+    # form fit() slices with local_batch_slice) — must be counted ONCE
+    # globally: each process evaluates only its row share (review r5)
+    ev_r = trainer2.evaluate(ds)
+    assert ev_r.num_examples() == 64, ev_r.num_examples()
+    assert (ev_r.confusion.matrix == ev.confusion.matrix).all()
+    scores_r = trainer2.score_examples(ds, add_regularization_terms=True)
+    assert scores_r.shape == (64,), scores_r.shape
+    np.testing.assert_allclose(scores_r, scores, rtol=0, atol=0)
+    # scalar score(ds): allreduced, identical on every process
+    with open(f"{outdir}/score_p{pid}.txt", "w") as f:
+        f.write(repr(trainer2.score(ds)))
+    print(f"proc {pid} replicated-eval done")
 
     # --- cross-node time source (NTPTimeSource analog) across the REAL
     # process boundary: proc 0 hosts the reference clock; proc 1 aligns

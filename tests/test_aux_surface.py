@@ -166,6 +166,34 @@ def test_file_topic_segment_roll_and_torn_tail(tmp_path):
     assert reopened.read(6) == b"w" * 8
 
 
+def test_file_topic_sequential_writer_handoff(tmp_path):
+    """Review finding r4: a second writer object over the same directory
+    (sequential handoff — the supported single-writer-at-a-time contract)
+    re-syncs its offset cursor against the on-disk tail before appending,
+    so interleaved sequential appends never mint duplicate offsets."""
+    from deeplearning4j_tpu.streaming.topic import FileTopic
+
+    a = FileTopic(tmp_path, "t")
+    b = FileTopic(tmp_path, "t")   # opened before a appended anything
+    offs = [a.append(b"a0"), a.append(b"a1"),
+            b.append(b"b0"),       # must see a's two appends
+            a.append(b"a2")]       # and a must see b's
+    assert offs == [0, 1, 2, 3]
+    assert [a.read(i) for i in range(4)] == [b"a0", b"a1", b"b0", b"a2"]
+    assert [b.read(i) for i in range(4)] == [b"a0", b"a1", b"b0", b"a2"]
+
+
+def test_coordinator_time_source_fails_at_construction():
+    """Review finding r4: an unreachable time server is a CONFIG error —
+    it must fail eagerly in __init__, never on the first stats.time()
+    inside a training loop."""
+    import pytest
+    from deeplearning4j_tpu.parallel.timesource import CoordinatorTimeSource
+
+    with pytest.raises(OSError):
+        CoordinatorTimeSource("127.0.0.1", 1, samples=1, timeout=0.2)
+
+
 def _small_net(n_in=6, n_out=3, seed=0):
     from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
                                     MultiLayerNetwork,

@@ -1,0 +1,301 @@
+"""Distributed evaluation & scoring plane.
+
+Reference parity targets:
+  * `MultiLayerNetwork.scoreExamples` (MultiLayerNetwork.java:1737,1754) —
+    per-example losses, regularization toggle, documented equivalence to
+    `score(DataSet)` on a single example.
+  * `RnnOutputLayer.computeScoreForExamples` (RnnOutputLayer.java:219) —
+    time-series scores summed over time per example, masked.
+  * Spark distributed evaluation (`IEvaluateFlatMapFunction.java:1` +
+    `IEvaluationReduceFunction.java`) — map per partition, reduce via
+    Evaluation.merge; multi-device == single-device is COUNT-exact.
+  * Spark per-example scoring (`ScoreExamplesFunction.java`) and VAE
+    reconstruction scoring
+    (`BaseVaeReconstructionProbWithKeyFunctionAdapter.java`).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, DataSet,
+                                DenseLayer, GravesLSTM, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer, Sgd,
+                                VariationalAutoencoder)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel import (ParallelTrainer, ShardingStrategy,
+                                         TrainingMode, make_mesh)
+
+
+def _graph_model(seed=11, l2=0.0):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("d", DenseLayer(n_out=16, activation="tanh", l2=l2), "in")
+    b.add_layer("out", OutputLayer(n_out=4, loss="mcxent", l2=l2), "d")
+    b.set_outputs("out")
+    b.set_input_types(InputType.feed_forward(8))
+    return ComputationGraph(b.build()).init()
+
+
+def _model(seed=7, l2=0.0, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh", l2=l2))
+            .layer(OutputLayer(n_out=4, loss="mcxent", l2=l2))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, n)]
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# L2: per-example scoring on the networks themselves
+# ---------------------------------------------------------------------------
+
+def test_score_examples_shape_and_mean_matches_score():
+    x, y = _data(32)
+    m = _model()
+    ds = DataSet(x, y)
+    per = m.score_examples(ds, add_regularization_terms=False)
+    assert per.shape == (32,)
+    # no reg: mean of per-example losses == the scalar score
+    np.testing.assert_allclose(per.mean(), m.score(ds), rtol=1e-6)
+
+
+def test_score_examples_single_example_equivalence_with_reg():
+    """Reference-documented semantics: row i (with reg terms) equals
+    score(DataSet) of that single example (MultiLayerNetwork.java:1746)."""
+    x, y = _data(8)
+    m = _model(l2=1e-2)
+    per = m.score_examples(DataSet(x, y), add_regularization_terms=True)
+    for i in range(8):
+        want = m.score(DataSet(x[i:i + 1], y[i:i + 1]))
+        np.testing.assert_allclose(per[i], want, rtol=1e-5)
+
+
+def test_score_examples_reg_toggle():
+    x, y = _data(16)
+    m = _model(l2=1e-2)
+    with_reg = m.score_examples(DataSet(x, y), True)
+    without = m.score_examples(DataSet(x, y), False)
+    diff = with_reg - without
+    # reg term is the same full-network scalar added to every example
+    assert diff.min() > 0
+    np.testing.assert_allclose(diff, diff[0], rtol=1e-5, atol=1e-6)
+
+
+def test_score_examples_iterator_concatenates():
+    x, y = _data(40)
+    m = _model()
+    it = ArrayDataSetIterator(x, y, batch_size=16)  # 16+16+8
+    per_it = m.score_examples(it, add_regularization_terms=False)
+    per_ds = m.score_examples(DataSet(x, y), add_regularization_terms=False)
+    np.testing.assert_allclose(per_it, per_ds, rtol=1e-6)
+
+
+def test_score_examples_rnn_masked_sums_over_time():
+    """RnnOutputLayer.java:219 — per-example score is the masked SUM of
+    per-timestep scores."""
+    r = np.random.default_rng(3)
+    B, T, F, C = 6, 5, 4, 3
+    x = r.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[r.integers(0, C, (B, T))]
+    lm = (r.random((B, T)) > 0.3).astype(np.float32)
+    lm[:, 0] = 1.0
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+            .list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y, labels_mask=lm)
+    per = m.score_examples(ds, add_regularization_terms=False)
+    assert per.shape == (B,)
+    # oracle: per-timestep mcxent of the network's own probabilities,
+    # masked, summed over time
+    probs = np.asarray(m.output(x))
+    per_t = -np.sum(y * np.log(np.clip(probs, 1e-30, None)), axis=-1)
+    want = (per_t * lm).sum(axis=1)
+    np.testing.assert_allclose(per, want, rtol=1e-4, atol=1e-6)
+
+
+def test_graph_score_examples_matches_multilayer():
+    """Single-output graph == the equivalent sequential net, per example."""
+    x, y = _data(24, seed=5)
+    mln = _model(seed=11, l2=1e-3)
+    gm = _graph_model(seed=11, l2=1e-3)
+    # same params
+    gm.params = {"d": mln.params[0], "out": mln.params[1]}
+    ds = DataSet(x, y)
+    np.testing.assert_allclose(
+        gm.score_examples(ds, True),
+        mln.score_examples(ds, True), rtol=1e-6)
+    np.testing.assert_allclose(
+        gm.score_examples(ds, False),
+        mln.score_examples(ds, False), rtol=1e-6)
+
+
+def test_vae_reconstruction_log_probability_network_level():
+    r = np.random.default_rng(2)
+    x = r.normal(size=(12, 6)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(VariationalAutoencoder(
+                n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+                activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    lp = m.reconstruction_log_probability(x, num_samples=4, seed=3)
+    assert lp.shape == (12,)
+    # deterministic for a given seed
+    np.testing.assert_allclose(
+        lp, m.reconstruction_log_probability(x, num_samples=4, seed=3))
+    # probability form is exp(log prob)
+    np.testing.assert_allclose(
+        m.reconstruction_probability(x, num_samples=4, seed=3),
+        np.exp(lp), rtol=1e-6)
+    # non-VAE first layer is rejected
+    with pytest.raises(ValueError):
+        _model().reconstruction_log_probability(x)
+
+
+# ---------------------------------------------------------------------------
+# Parallel plane: mesh-sharded evaluate / score_examples == single-device
+# ---------------------------------------------------------------------------
+
+def _trained_pair(l2=0.0, updater=None, seed=9):
+    x, y = _data(64, seed=1)
+    single = _model(seed=seed, l2=l2, updater=updater)
+    multi = _model(seed=seed, l2=l2, updater=updater)
+    ds = DataSet(x, y)
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    for _ in range(3):
+        single.fit(ds)
+        trainer.fit(ds)
+    return single, trainer
+
+
+def test_parallel_evaluate_matches_single_device_exactly():
+    single, trainer = _trained_pair()
+    # 70 examples: uneven => exercises the pad-and-slice path (8x9=72)
+    x, y = _data(70, seed=2)
+    it = ArrayDataSetIterator(x, y, batch_size=35)
+    ev_single = single.evaluate(ArrayDataSetIterator(x, y, batch_size=35))
+    ev_multi = trainer.evaluate(it)
+    # count-exact: identical confusion matrices, not just close accuracy
+    np.testing.assert_array_equal(ev_multi.confusion.matrix,
+                                  ev_single.confusion.matrix)
+    assert ev_multi.num_examples() == 70
+
+
+def test_parallel_evaluate_top_n_and_labels():
+    single, trainer = _trained_pair()
+    x, y = _data(48, seed=4)
+    names = ["a", "b", "c", "d"]
+    ev_s = single.evaluate(ArrayDataSetIterator(x, y, batch_size=16),
+                           labels_list=names, top_n=2)
+    ev_m = trainer.evaluate(ArrayDataSetIterator(x, y, batch_size=16),
+                            labels_list=names, top_n=2)
+    assert ev_m.top_n_correct == ev_s.top_n_correct
+    assert ev_m.top_n_total == ev_s.top_n_total
+    assert ev_m.label_names == names
+
+
+def test_parallel_score_examples_matches_single_device():
+    single, trainer = _trained_pair(l2=1e-3)
+    x, y = _data(70, seed=6)
+    ds = DataSet(x, y)
+    for add_reg in (True, False):
+        np.testing.assert_allclose(
+            trainer.score_examples(ds, add_reg),
+            single.score_examples(ds, add_reg), rtol=1e-6, atol=1e-9)
+
+
+def test_parallel_evaluate_tensor_parallel_strategy():
+    """The plane works with sharded params too (beyond the reference, which
+    only had replicated executors)."""
+    x, y = _data(64, seed=1)
+    single = _model(seed=13)
+    multi = _model(seed=13)
+    ds = DataSet(x, y)
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 2, "model": 4}),
+                              mode=TrainingMode.SYNC,
+                              strategy=ShardingStrategy.TENSOR_PARALLEL)
+    single.fit(ds)
+    trainer.fit(ds)
+    ev_s = single.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    ev_m = trainer.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    np.testing.assert_array_equal(ev_m.confusion.matrix,
+                                  ev_s.confusion.matrix)
+    np.testing.assert_allclose(
+        trainer.score_examples(ds, True), single.score_examples(ds, True),
+        rtol=1e-5, atol=1e-8)
+
+
+def test_parallel_evaluate_averaging_mode():
+    """AVERAGING mode evaluates the averaged-replica view (what sync_back
+    publishes)."""
+    x, y = _data(64, seed=1)
+    model = _model(seed=17)
+    ds = DataSet(x, y)
+    trainer = ParallelTrainer(model, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.AVERAGING,
+                              averaging_frequency=2)
+    trainer.fit(ds)
+    trainer.fit(ds)
+    ev = trainer.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    # reference check: sync_back then evaluate single-device
+    trainer._sync_back()
+    ev_ref = model.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    np.testing.assert_array_equal(ev.confusion.matrix,
+                                  ev_ref.confusion.matrix)
+
+
+def test_parallel_vae_reconstruction_matches_single():
+    r = np.random.default_rng(8)
+    x = r.normal(size=(40, 6)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(VariationalAutoencoder(
+                n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+                activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    trainer = ParallelTrainer(m, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    want = m.reconstruction_log_probability(x, num_samples=4, seed=5)
+    got = trainer.reconstruction_log_probability(DataSet(
+        x, np.zeros((40, 2), np.float32)), num_samples=4, seed=5)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-8)
+
+
+def test_graph_parallel_evaluate_and_score_examples():
+    x, y = _data(64, seed=1)
+    single = _graph_model(seed=21)
+    multi = _graph_model(seed=21)
+    ds = DataSet(x, y)
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    single.fit(ds)
+    trainer.fit(ds)
+    ev_s = single.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    ev_m = trainer.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+    np.testing.assert_array_equal(ev_m.confusion.matrix,
+                                  ev_s.confusion.matrix)
+    np.testing.assert_allclose(
+        trainer.score_examples(ds, True), single.score_examples(ds, True),
+        rtol=1e-6, atol=1e-9)

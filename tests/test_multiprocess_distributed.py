@@ -55,8 +55,8 @@ def test_two_process_training_matches_single_process(tmp_path):
 
     conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
             .list()
-            .layer(DenseLayer(n_out=16, activation="tanh"))
-            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .layer(DenseLayer(n_out=16, activation="tanh", l2=1e-3))
+            .layer(OutputLayer(n_out=4, loss="mcxent", l2=1e-3))
             .set_input_type(InputType.feed_forward(8))
             .build())
     single = MultiLayerNetwork(conf).init()
@@ -75,6 +75,28 @@ def test_two_process_training_matches_single_process(tmp_path):
     e1 = np.load(tmp_path / "params_export_p1.npy")
     np.testing.assert_allclose(e0, e1, rtol=0, atol=0)
     np.testing.assert_allclose(e0, p0, rtol=0, atol=0)
+
+    # distributed evaluation/scoring plane: merged Evaluation and
+    # allgathered per-example scores identical across processes and equal
+    # to single-process evaluation of the full dataset
+    from deeplearning4j_tpu import ArrayDataSetIterator
+    m0 = np.load(tmp_path / "evalmat_p0.npy")
+    m1 = np.load(tmp_path / "evalmat_p1.npy")
+    np.testing.assert_array_equal(m0, m1)
+    ev_single = single.evaluate(ArrayDataSetIterator(x, y, batch_size=64))
+    np.testing.assert_array_equal(m0, ev_single.confusion.matrix)
+    assert int(m0.sum()) == 64
+    s0 = np.load(tmp_path / "scores_p0.npy")
+    s1 = np.load(tmp_path / "scores_p1.npy")
+    np.testing.assert_allclose(s0, s1, rtol=0, atol=0)
+    np.testing.assert_allclose(
+        s0, single.score_examples(ds, add_regularization_terms=True),
+        rtol=2e-5, atol=1e-6)
+    # allreduced scalar score(ds) is identical on every process
+    sc0 = (tmp_path / "score_p0.txt").read_text()
+    sc1 = (tmp_path / "score_p1.txt").read_text()
+    assert sc0 == sc1
+    np.testing.assert_allclose(float(sc0), single.score(ds), rtol=2e-5)
 
     # time-source tier crossed the process boundary: both processes
     # produced offset-corrected stamps on one timeline (same host here,

@@ -63,11 +63,12 @@ def test_time_source_survives_dead_server():
     for _ in range(3):
         assert ts.offset_ms() == pytest.approx(first)   # stale, no raise
         time.sleep(0.1)
-    # a NEVER-measured source against a dead server must raise loudly
-    dead = CoordinatorTimeSource("127.0.0.1", srv.port, samples=1,
-                                 timeout=0.3)
+    # against a dead server the source must fail EAGERLY at construction
+    # (a config error there, not a crash inside the training loop —
+    # review finding r4)
     with pytest.raises(OSError):
-        dead.offset_ms()
+        CoordinatorTimeSource("127.0.0.1", srv.port, samples=1,
+                              timeout=0.3)
 
 
 def test_time_source_provider_env(monkeypatch):
@@ -78,9 +79,17 @@ def test_time_source_provider_env(monkeypatch):
     monkeypatch.delenv(m.SERVER_ENV, raising=False)
     with pytest.raises(ValueError, match="requires"):
         m.get_time_source()
-    monkeypatch.setenv(m.SERVER_ENV, "127.0.0.1:9")
+    # a live server: the provider returns a coordinator source (which now
+    # measures eagerly at construction)
+    srv = m.TimeServer()
+    monkeypatch.setenv(m.SERVER_ENV, f"{srv.host}:{srv.port}")
     ts = m.get_time_source()
     assert isinstance(ts, m.CoordinatorTimeSource)
+    srv.close()
+    # a dead server is a loud config error at construction time
+    monkeypatch.setenv(m.SERVER_ENV, "127.0.0.1:9")
+    with pytest.raises(OSError):
+        m.get_time_source()
     monkeypatch.setenv(m.SOURCE_ENV, "bogus")
     with pytest.raises(ValueError, match="unknown"):
         m.get_time_source()

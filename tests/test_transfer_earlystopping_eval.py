@@ -157,6 +157,109 @@ def test_early_stopping_iteration_condition(classification_data):
 
 # --------------------------- ROC / regression ------------------------------
 
+def test_early_stopping_parallel_trainer(classification_data):
+    """EarlyStoppingParallelTrainer.java:1 analog: early stopping drives a
+    multi-device ParallelTrainer; termination fires, the best model is
+    saved/restored from the mesh-trained params, and the result matches the
+    single-device early-stopping run exactly (same math, SYNC dp)."""
+    from deeplearning4j_tpu.earlystopping import EarlyStoppingParallelTrainer
+    from deeplearning4j_tpu.parallel import (ParallelTrainer, TrainingMode,
+                                             make_mesh)
+
+    xs, ys = classification_data
+    train = lambda: ArrayDataSetIterator(xs[:192], ys[:192], batch_size=64)
+    val = lambda: ArrayDataSetIterator(xs[192:], ys[192:], batch_size=64)
+
+    def config():
+        return (EarlyStoppingConfiguration.Builder()
+                .score_calculator(DataSetLossCalculator(val()))
+                .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+                .model_saver(InMemoryModelSaver())
+                .build())
+
+    # single-device reference run
+    single = _base_model(seed=4)
+    res_single = EarlyStoppingTrainer(config(), single, train()).fit()
+
+    # mesh run: 8-way data parallel
+    model = _base_model(seed=4)
+    trainer = ParallelTrainer(model, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    res = EarlyStoppingParallelTrainer(config(), train_iter=train(),
+                                       trainer=trainer).fit()
+    assert res.termination_reason == "EpochTerminationCondition"
+    assert res.total_epochs == res_single.total_epochs == 4
+    assert res.best_model is not None
+    # validation scores per epoch match the single-device run
+    for e, s in res_single.score_vs_epoch.items():
+        np.testing.assert_allclose(res.score_vs_epoch[e], s, rtol=1e-4,
+                                   atol=1e-6)
+    # best-restore: saved params equal the single-device best model's
+    np.testing.assert_allclose(res.best_model.params_flat(),
+                               res_single.best_model.params_flat(),
+                               rtol=1e-4, atol=1e-6)
+    # the restored best model scores the validation set as recorded
+    calc = DataSetLossCalculator(val())
+    np.testing.assert_allclose(calc.calculate_score(res.best_model),
+                               res.best_model_score, rtol=1e-4, atol=1e-6)
+
+
+def test_early_stopping_parallel_iteration_condition(classification_data):
+    """Iteration-level termination works through the trainer (score() after
+    each sharded step feeds MaxScoreIterationTerminationCondition)."""
+    from deeplearning4j_tpu.earlystopping import EarlyStoppingParallelTrainer
+    from deeplearning4j_tpu.parallel import TrainingMode, make_mesh
+
+    xs, ys = classification_data
+    model = _base_model(seed=5)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(xs, ys, batch_size=64)))
+           .iteration_termination_conditions(
+               MaxScoreIterationTerminationCondition(1e-9))  # fires at once
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+           .build())
+    es = EarlyStoppingParallelTrainer(
+        cfg, model=model, train_iter=ArrayDataSetIterator(xs, ys,
+                                                          batch_size=64),
+        mesh=make_mesh({"data": 8}), mode=TrainingMode.SYNC)
+    result = es.fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+    assert result.termination_details == "MaxScoreIterationTerminationCondition"
+
+
+def test_early_stopping_parallel_averaging_preserves_cadence():
+    """Review r5: the ES loop must not publish (and thereby average) the
+    replicas after every minibatch in AVERAGING mode — local-SGD replicas
+    stay divergent until averaging_frequency says otherwise."""
+    import jax
+    from deeplearning4j_tpu.earlystopping import EarlyStoppingParallelTrainer
+    from deeplearning4j_tpu.parallel import (ParallelTrainer, TrainingMode,
+                                             make_mesh)
+
+    r = np.random.default_rng(1)
+    xs = r.normal(size=(64, 10)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[r.integers(0, 3, 64)]
+    trainer = ParallelTrainer(_base_model(seed=6),
+                              mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.AVERAGING,
+                              averaging_frequency=100)  # never within run
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(xs, ys, batch_size=32)))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    res = EarlyStoppingParallelTrainer(
+        cfg, train_iter=ArrayDataSetIterator(xs, ys, batch_size=32),
+        trainer=trainer).fit()
+    assert res.total_epochs == 2 and res.best_model is not None
+    # replicas trained on different shards and were never averaged
+    leaf = np.asarray(jax.tree_util.tree_leaves(trainer._params)[0])
+    assert leaf.shape[0] == 8
+    assert not np.allclose(leaf[0], leaf[1])
+
+
 def test_roc_perfect_classifier():
     roc = ROC(threshold_steps=50)
     labels = np.array([0, 0, 1, 1, 0, 1] * 10)
