@@ -25,16 +25,86 @@ from .glove import Glove
 from .word2vec import ParagraphVectors, Word2Vec
 
 __all__ = ["DistributedWord2Vec", "DistributedGlove",
-           "DistributedParagraphVectors"]
+           "DistributedParagraphVectors", "ModelExporter",
+           "InMemoryExporter", "FileModelExporter"]
+
+
+# ---------------------------------------------------------------------------
+# Exporter SPI — the `SparkModelExporter` analog
+# (dl4j-spark-nlp-java8/.../sequencevectors/export/SparkModelExporter.java:
+# a pluggable sink the trained vocab + vectors are pushed through when
+# training finishes; impls `VocabCacheExporter.java:1` collects into an
+# in-memory Word2Vec, `HdfsModelExporter.java` streams to storage).
+# ---------------------------------------------------------------------------
+class ModelExporter:
+    """`export(model)` receives the trained Distributed* model (vocab +
+    lookup table populated). Attach via `exporter=` or `.set_exporter`."""
+
+    def export(self, model):
+        raise NotImplementedError
+
+
+class InMemoryExporter(ModelExporter):
+    """VocabCacheExporter analog: captures vocab, lookup table, and a
+    query-ready WordVectorsModel on the exporter itself."""
+
+    def __init__(self):
+        self.vocab = None
+        self.lookup_table = None
+        self.word_vectors = None
+
+    def export(self, model):
+        from .embeddings import WordVectorsModel
+
+        self.vocab = model.vocab
+        self.lookup_table = model.lookup_table
+        self.word_vectors = WordVectorsModel(model.vocab, model.lookup_table)
+
+
+class FileModelExporter(ModelExporter):
+    """HdfsModelExporter analog: streams the trained vectors to a path
+    through `WordVectorSerializer` (format: 'text' | 'binary' | 'zip')."""
+
+    def __init__(self, path: str, fmt: str = "text"):
+        if fmt not in ("text", "binary", "zip"):
+            raise ValueError(f"unknown export format {fmt!r}")
+        self.path = str(path)
+        self.fmt = fmt
+
+    def export(self, model):
+        from .embeddings import WordVectorsModel
+        from .serializer import WordVectorSerializer as S
+
+        wv = WordVectorsModel(model.vocab, model.lookup_table)
+        if self.fmt == "text":
+            S.write_word_vectors(wv, self.path)
+        elif self.fmt == "binary":
+            S.write_binary(wv, self.path)
+        else:
+            S.write_word2vec_model(model, self.path)
 
 
 class _MeshMixin:
     """Shared mesh plumbing for the Distributed* embedding models: batch
-    placement over the data axis + divisibility handling."""
+    placement over the data axis + divisibility handling + the exporter
+    hook (`SparkSequenceVectors.fitSequences` ends by pushing the trained
+    model through its configured SparkModelExporter)."""
 
-    def _init_mesh(self, mesh: Optional[Mesh], data_axis: str):
+    def _init_mesh(self, mesh: Optional[Mesh], data_axis: str,
+                   exporter: Optional[ModelExporter] = None):
         self.mesh = mesh
         self.data_axis = data_axis
+        self.exporter = exporter
+
+    def set_exporter(self, exporter: ModelExporter):
+        self.exporter = exporter
+        return self
+
+    def fit(self, *a, **kw):
+        out = super().fit(*a, **kw)
+        if self.exporter is not None:
+            self.exporter.export(self)
+        return out
 
     def _axis_size(self) -> int:
         return self.mesh.shape[self.data_axis] if self.mesh is not None else 1
@@ -77,9 +147,10 @@ class DistributedWord2Vec(_MeshMixin, Word2Vec):
     TestCompareParameterAveragingSparkVsSingleMachine.java:44 pattern."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 data_axis: str = "data", **kw):
+                 data_axis: str = "data",
+                 exporter: Optional[ModelExporter] = None, **kw):
         super().__init__(**kw)
-        self._init_mesh(mesh, data_axis)
+        self._init_mesh(mesh, data_axis, exporter)
 
     def _sg_round_batch(self, B: int) -> int:
         return self._round_up(B)   # derived centers-per-step: round safely
@@ -104,9 +175,10 @@ class DistributedParagraphVectors(_MeshMixin, ParagraphVectors):
     tests/test_nlp_distributed.py."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 data_axis: str = "data", **kw):
+                 data_axis: str = "data",
+                 exporter: Optional[ModelExporter] = None, **kw):
         super().__init__(**kw)
-        self._init_mesh(mesh, data_axis)
+        self._init_mesh(mesh, data_axis, exporter)
 
     def _pair_round_batch(self, B: int) -> int:
         return self._require_divisible(B)
@@ -127,9 +199,10 @@ class DistributedGlove(_MeshMixin, Glove):
     axis — unlike the reference's per-partition updates)."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 data_axis: str = "data", **kw):
+                 data_axis: str = "data",
+                 exporter: Optional[ModelExporter] = None, **kw):
         super().__init__(**kw)
-        self._init_mesh(mesh, data_axis)
+        self._init_mesh(mesh, data_axis, exporter)
 
     def _batch_round(self, B: int) -> int:
         return self._require_divisible(B)

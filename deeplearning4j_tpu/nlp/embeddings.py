@@ -231,20 +231,10 @@ def make_cbow_step(table: InMemoryLookupTable, window: int):
     return step
 
 
-def _sgns_expected_step(vc, s1n, ctx, vm, nvalid, pn, K):
-    """Loss + hand-derived gradients of the expected-NS skip-gram objective
-    (loss identical to the autodiff form the tests check against):
-
-        L = -[ sum_bj vm * log sig(l[b, ctx_bj])
-               + K * sum_b nvalid_b * (log sig(-l[b, :]) @ pn) ],
-        l = vc @ s1n.T.
-
-    Hand-written because autodiff's backward scatters the sparse positive
-    term into a dense [B, V] cotangent (XLA lowers it as flat reshapes) —
-    profiled at ~45% of the W2V device epoch. Here the dense [B, V]
-    matrix gets exactly one producer (the MXU matmul) and three fused
-    consumers (loss reduce + two gradient matmuls); the positive term
-    stays sparse: [B, 2W] gathers and one 2W*B-row scatter-add.
+def _sgns_expected_step_scatter(vc, s1n, ctx, vm, nvalid, pn, K):
+    """Round-3/4 scatter formulation of the expected-NS gradients — kept as
+    the numerical ORACLE for `_sgns_expected_step` (the shipped scatter-free
+    form below) and for CPU paths where XLA scatters are cheap.
 
       dL/dl = K*nvalid[:,None]*pn[None,:]*sig(l)        (dense)
               - sig(-l[gathered])*vm at (b, ctx_bj)     (sparse)
@@ -263,6 +253,59 @@ def _sgns_expected_step(vc, s1n, ctx, vm, nvalid, pn, K):
     gs1n = (K * pn)[:, None] * ((sg * nvalid[:, None]).T @ vc)
     upd = (w_pos[:, :, None] * vc[:, None, :]).reshape(-1, vc.shape[1])
     gs1n = gs1n.at[ctx.reshape(-1)].add(-upd)
+    return loss, gvc, gs1n
+
+
+def _sgns_expected_step(vc, s1n, ctx, vm, nvalid, pn, K):
+    """Scatter-FREE expected-NS gradients (same math as the scatter oracle
+    above — tests assert equality in f64).
+
+    Round-5 profile (xprof on the chip, B=1638 V=10k D=128): the scan
+    step spent 65% of its time in XLA 'custom fusion' scatter/gather ops
+    (the [2W*B]-row `gs1n.at[ctx].add` scatter and friends serialize on
+    TPU), only 21% on the MXU. The TPU-native move is to assemble the FULL
+    dense cotangent
+
+        A = dL/dl = K*nvalid[:,None]*pn[None,:]*sig(l) - M,
+        M[b,v]   = sum_j w_pos[b,j] * [ctx[b,j] == v]
+
+    where M is built by 2W unrolled iota-compares (one fused elementwise
+    pass over [B, V] — no scatter), so BOTH gradients collapse to one
+    matmul each:  gvc = A @ s1n,  gs1n = A.T @ vc.  Even the [B, 2W]
+    positive-logit gather is folded into the same pass as 2W masked row
+    reductions (TPU row gathers from a [B, V] matrix are serialized
+    custom fusions; a fused compare+select+reduce is one VPU sweep).
+    The reference's per-pair update loop is `SkipGram.java:156`; this
+    computes its exact expectation with the sparse-update plumbing mapped
+    onto the MXU."""
+    # The two [B, V] sweeps (glj extraction, A assembly) are
+    # bandwidth-bound; in the f32 production path the logits matrix is
+    # kept bf16 so each sweep moves half the bytes (the f64 path — CPU
+    # gradchecks, oracle-equality tests — stays full precision). All
+    # reductions and both gradient matmuls accumulate in f32 via
+    # preferred_element_type.
+    fast = vc.dtype == jnp.float32
+    ldt = jnp.bfloat16 if fast else vc.dtype
+    acc = jnp.float32 if fast else vc.dtype
+    logits = jnp.matmul(vc.astype(ldt), s1n.astype(ldt).T,
+                        preferred_element_type=acc).astype(ldt)  # [B, V]
+    sg = jax.nn.sigmoid(logits)
+    neg_vec = jnp.einsum("bv,v->b", jax.nn.log_sigmoid(-logits),
+                         pn.astype(ldt), preferred_element_type=acc)
+    neg_l = jnp.sum(K * nvalid * neg_vec)
+    viota = jax.lax.broadcasted_iota(ctx.dtype, (1, logits.shape[1]), 1)
+    a = ((K * nvalid)[:, None] * (pn[None, :] * sg.astype(acc))).astype(ldt)
+    pos_l = jnp.asarray(0.0, acc)
+    for j in range(ctx.shape[1]):                           # 2W unrolled
+        eq = ctx[:, j:j + 1] == viota                       # [B, V]
+        glj = jnp.sum(jnp.where(eq, logits, 0), axis=1,
+                      dtype=acc)                            # [B]
+        pos_l = pos_l + jnp.sum(jax.nn.log_sigmoid(glj) * vm[:, j])
+        wj = (jax.nn.sigmoid(-glj) * vm[:, j]).astype(ldt)  # [B]
+        a = a - jnp.where(eq, wj[:, None], jnp.asarray(0, ldt))
+    loss = -(pos_l + neg_l)
+    gvc = jnp.matmul(a, s1n.astype(ldt), preferred_element_type=acc)
+    gs1n = jnp.matmul(a.T, vc.astype(ldt), preferred_element_type=acc)
     return loss, gvc, gs1n
 
 
@@ -290,33 +333,48 @@ def make_skipgram_corpus_runner(table: InMemoryLookupTable, window: int):
     assert K > 0, "corpus runner is NS-only; HS uses the pair path"
     pn = table.sampler.probs
     W = int(window)
-    offs = jnp.concatenate([jnp.arange(-W, 0), jnp.arange(1, W + 1)])
+    offs_list = list(range(-W, 0)) + list(range(1, W + 1))
+    offs = jnp.asarray(offs_list)
 
     @jax.jit
     def run(syn0, syn1neg, corpus, sid, positions, lrs, rng):
         n = corpus.shape[0]
-        # NOTE: window gathers stay INSIDE the scan on purpose — an
-        # epoch-wide hoist was measured perf-NEUTRAL (the per-step gathers
-        # already overlap MXU work) but materializes O(corpus x 2W)
-        # device arrays, which would OOM large corpora.
+        # Window tables: ctx_tab[i, j] = corpus[i + offs[j]] built ONCE per
+        # epoch from 2W rolls (pure vector shifts). Inside the scan the
+        # per-center window is then ONE [B]-row gather of contiguous
+        # 2W-wide rows — the r5 profile clocked the per-element
+        # corpus[pos+off] form at 232 us/step (TPU gathers of 16k SCALARS
+        # serialize; 1.6k contiguous-row gathers are ~30 us). Cost: a
+        # corpus x 2W x int32 device table (80 MB per 1M words) — the
+        # r4-era OOM concern priced at O(corpus) HBM, which a 16 GB part
+        # absorbs to ~100M words; beyond that, shard the corpus epoch.
+        ctx_tab = jnp.stack([jnp.roll(corpus, -o) for o in offs_list],
+                            axis=1)                     # [n, 2W]
+        sid_tab = jnp.stack([jnp.roll(sid, -o) for o in offs_list],
+                            axis=1)                     # [n, 2W]
 
         def body(carry, inp):
             s0, s1n = carry
             pos, lr, k = inp
             b = jax.random.randint(k, pos.shape, 1, W + 1)
             j = pos[:, None] + offs[None, :]
-            jc = jnp.clip(j, 0, n - 1)
             valid = ((j >= 0) & (j < n)
                      & (jnp.abs(offs)[None, :] <= b[:, None])
-                     & (sid[jc] == sid[pos][:, None]))
+                     & (sid_tab[pos] == sid[pos][:, None]))
             centers = corpus[pos]                       # [B]
-            ctx = corpus[jc]                            # [B, 2W]
+            ctx = ctx_tab[pos]                          # [B, 2W] row gather
             vm = valid.astype(jnp.float32)
             nvalid = jnp.sum(vm, axis=1)                # [B]
             vc0 = s0[centers]                           # [B, D]
             loss, gvc, gs1n = _sgns_expected_step(
                 vc0, s1n, ctx, vm, nvalid, pn, K)
-            s0 = s0.at[centers].add(-lr * gvc)
+            # scatter-add(centers) == one-hot.T @ gvc on the MXU —
+            # duplicate centers sum exactly as scatter-add would (XLA
+            # lowers the recognized pattern efficiently, ~30 us vs the
+            # 165 us serialized scatter it replaced)
+            oh = (centers[:, None] == jax.lax.broadcasted_iota(
+                centers.dtype, (1, s0.shape[0]), 1)).astype(s0.dtype)
+            s0 = s0 - lr * (oh.T @ gvc)
             return (s0, s1n - lr * gs1n), loss
 
         keys = jax.random.split(rng, positions.shape[0])

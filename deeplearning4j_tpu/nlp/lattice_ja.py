@@ -48,11 +48,20 @@ EOS = "$"
 # bigram, larger = disfavored): negative "bonuses" would reward paths for
 # taking MORE transitions — the same cost inversion that broke negative
 # word costs (see _LEX_SRC note). Scale matches the word costs (~5-120).
+#
+# Round 5: when `resources/ja_costs.json` exists (written by
+# experiments/train_ja_costs.py from the reference's vendored IPADIC
+# dumps), the curated matrix below is REPLACED by learned bigram
+# transition costs (-S ln P(c2|c1), smoothed) and the unknown-edge model
+# by learned script/length statistics — the `ConnectionCosts.java` /
+# `UnknownDictionary.java` analog actually estimated from data.
 _CONN: Dict[Tuple[str, str], int] = {}
+_CONN_DEFAULT = 30
+_LEARNED = False
 
 
 def _conn_default(a: str, b: str) -> int:
-    return 30
+    return _CONN_DEFAULT
 
 
 def _set(a: str, b: str, cost: int):
@@ -171,11 +180,12 @@ JA_LEXICON: Dict[str, List[Tuple[int, str]]] = {}
 
 
 def _load_freq_lexicon() -> int:
-    """Merge the bundled frequency-derived lexicon
-    (resources/ja_lexicon.tsv — generated from the reference's vendored
-    Kuromoji/IPADIC output by experiments/build_ja_lexicon.py) into
-    JA_LEXICON with log-frequency word costs (the IPADIC cost recipe).
-    Returns the number of entries loaded."""
+    """Merge the bundled lexicon (resources/ja_lexicon.tsv) into
+    JA_LEXICON. Two formats: 3 columns (surface, count, class) gets the
+    log-frequency cost recipe; 4 columns carries a LEARNED cost per
+    (surface, class) — written by experiments/train_ja_costs.py from the
+    reference's vendored Kuromoji/IPADIC output. Returns the number of
+    entries loaded."""
     import math
     import os
 
@@ -190,30 +200,74 @@ def _load_freq_lexicon() -> int:
     with f:
         for line in f:
             parts = line.rstrip("\n").split("\t")
-            if len(parts) != 3:
+            if len(parts) == 4:
+                surf, n, cls, cost = parts
+                JA_LEXICON.setdefault(surf, []).append((int(cost), cls))
+            elif len(parts) == 3:
+                surf, n, cls = parts
+                # positive log-frequency cost (IPADIC recipe): the most
+                # frequent surfaces approach the closed-class floor, rare
+                # ones approach the unknown-edge region
+                cost = max(6, int(100 - 12 * math.log(int(n) + 1)))
+                JA_LEXICON.setdefault(surf, []).append((cost, cls))
+            else:
                 continue
-            surf, n, cls = parts
-            n = int(n)
-            # positive log-frequency cost (IPADIC recipe): the most
-            # frequent surfaces approach the closed-class floor, rare
-            # ones approach the unknown-edge region
-            cost = max(6, int(100 - 12 * math.log(n + 1)))
-            JA_LEXICON.setdefault(surf, []).append((cost, cls))
             n_loaded += 1
     return n_loaded
 
 
-_FREQ_ENTRIES = _load_freq_lexicon()
+def _load_learned_costs() -> bool:
+    """Load learned connection + unknown-edge costs (ja_costs.json) if
+    bundled; returns True when the learned tables replaced the curated
+    ones."""
+    import json
+    import os
 
-for _w, _c, _cls in _LEX_SRC:
-    cost = _c
-    if (len(_w) == 1 and _cls == NOUN
-            and 0x4E00 <= ord(_w) <= 0x9FFF):
-        # single-kanji nouns (日/中/本/人...) appear inside compounds far
-        # more often than as standalone words — weaken them so unknown
-        # compound runs (田中) stay whole
-        cost = 75
-    JA_LEXICON.setdefault(_w, []).append((cost, _cls))
+    global _CONN_DEFAULT, _LEARNED
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "resources", "ja_costs.json")
+    # parse into FRESH dicts first and swap only on full success: a
+    # malformed file must leave the curated tables intact (and the module
+    # importable) rather than clearing _CONN halfway (review r5)
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        conn = {}
+        for key, cost in data["conn"].items():
+            a, b = key.split(" ")
+            conn[(a, b)] = int(cost)
+        unk = data["unk"]
+        base = {k: int(v) for k, v in unk["base"].items()}
+        per_char = {k: int(v) for k, v in unk["per_char"].items()}
+        max_len = {k: max(4, int(v)) for k, v in unk["max_len"].items()}
+        char_cost = {k: int(v)
+                     for k, v in unk.get("char_cost", {}).items()}
+        char_default = {k: int(v)
+                        for k, v in unk.get("char_default", {}).items()}
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        return False
+    _CONN.clear()
+    _CONN.update(conn)
+    _UNK_BASE.clear()
+    _UNK_BASE.update(base)
+    _UNK_PER_CHAR.clear()
+    _UNK_PER_CHAR.update(per_char)
+    _UNK_MAX_LEN.clear()
+    _UNK_MAX_LEN.update(max_len)
+    _UNK_CHAR_COST.clear()
+    _UNK_CHAR_COST.update(char_cost)
+    _UNK_CHAR_DEFAULT.clear()
+    _UNK_CHAR_DEFAULT.update(char_default)
+    # unseen transition on the learned scale ~= a very low-probability
+    # bigram (the learned tables enumerate all class pairs, so this only
+    # fires for exotic combinations)
+    _CONN_DEFAULT = max(_CONN.values()) if _CONN else 30
+    _LEARNED = True
+    return True
+
+
+_FREQ_ENTRIES = _load_freq_lexicon()
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +296,25 @@ def _script(ch: str) -> str:
 _UNK_BASE = {"kanji": 60, "kata": 40, "latin": 30, "hira": 120}
 _UNK_PER_CHAR = {"kanji": 25, "kata": 3, "latin": 2, "hira": 60}
 _UNK_MAX_LEN = {"kanji": 4, "kata": 24, "latin": 48, "hira": 6}
+# learned char-identity costs for unknown spans (-S ln P(ch|script); empty
+# = curated flat per-char model)
+_UNK_CHAR_COST: Dict[str, int] = {}
+_UNK_CHAR_DEFAULT: Dict[str, int] = {}
+
+# learned tables (if bundled) replace the curated connection/unknown
+# costs; the curated hand-scale lexicon entries merge in ONLY when no
+# learned model is present (their cost scale differs)
+_load_learned_costs()
+if not _LEARNED:
+    for _w, _c, _cls in _LEX_SRC:
+        _cost = _c
+        if (len(_w) == 1 and _cls == NOUN
+                and 0x4E00 <= ord(_w) <= 0x9FFF):
+            # single-kanji nouns (日/中/本/人...) appear inside compounds
+            # far more often than as standalone words — weaken them so
+            # unknown compound runs (田中) stay whole
+            _cost = 75
+        JA_LEXICON.setdefault(_w, []).append((_cost, _cls))
 
 
 class LatticeTokenizer:
@@ -274,6 +347,12 @@ class LatticeTokenizer:
                        else [run_end - i])
             for L in lengths:
                 cost = _UNK_BASE[s] + _UNK_PER_CHAR[s] * L
+                if _UNK_CHAR_COST:
+                    # learned char-identity term: word-like characters
+                    # make cheap unknown words (-S ln P(ch|script))
+                    dflt = _UNK_CHAR_DEFAULT.get(s, 100)
+                    cost += sum(_UNK_CHAR_COST.get(c2, dflt)
+                                for c2 in text[i:i + L])
                 out.append((i + L, cost, UNK))
         if not out:  # always offer the single char so the DP can't strand
             out.append((i + 1, 400, UNK))
@@ -282,7 +361,10 @@ class LatticeTokenizer:
     def tokenize_tagged(self, text: str) -> List[Tuple[str, str]]:
         toks: List[Tuple[str, str]] = []
         for seg in self._segments(text):
-            toks.extend(self._viterbi(seg))
+            # learned lattices use refined internal classes ("P:係助詞",
+            # "V:連用形", ...); the public tag stays the coarse class
+            toks.extend((s, c.split(":", 1)[0])
+                        for s, c in self._viterbi(seg))
         return toks
 
     def tokenize(self, text: str) -> List[str]:

@@ -345,7 +345,13 @@ class SequenceVectors(WordVectorsModel):
                 table, self.window_size)
             self._sg_runner_key = runner_key
         runner = self._sg_runner
-        rng = jax.random.PRNGKey(self.seed)
+        # fold the per-model fit count into the stream so INCREMENTAL fits
+        # continue training with fresh shuffles/negatives instead of
+        # replaying epoch 1 byte-for-byte (the old stateful np_rng gave
+        # this implicitly; a bare PRNGKey(seed) would not — review r5)
+        fit_idx = getattr(self, "_sg_fit_count", 0)
+        self._sg_fit_count = fit_idx + 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), fit_idx)
         syn0, syn1neg = table.syn0, table.syn1neg
         # batch_size counts PAIRS (as in the pair path); a center yields
         # ~window pairs, so derive centers-per-step from it. Additionally cap
@@ -387,9 +393,12 @@ class SequenceVectors(WordVectorsModel):
             # bucketed scan length: token-count jitter between subsampled
             # epochs must not recompile the epoch graph (padded steps lr=0)
             T2 = pad_scan_length(T)
-            # shuffled center positions; wrap to fill the last batch
-            perm = self._np_rng.permutation(n)
-            pos = np.resize(perm, T2 * B).reshape(T2, B).astype(np.int32)
+            # shuffled center positions, generated ON DEVICE: uploading a
+            # host [T2, B] position matrix cost ~0.5 s/epoch through the
+            # ~15 MB/s attach tunnel — over half the r5 steady epoch
+            # (profiled; the device permutation is milliseconds)
+            rng, pk = jax.random.split(rng)
+            pos_dev = self._sg_positions_device(pk, n, T2, B)
             # linear decay normalized by SEEN (post-filter) tokens so the lr
             # actually reaches min_learning_rate by the last epoch
             frac = np.minimum(
@@ -398,13 +407,29 @@ class SequenceVectors(WordVectorsModel):
                              self.learning_rate * (1.0 - frac))
             lrs[T:] = 0.0
             rng, k = jax.random.split(rng)
-            pos_dev = self._sg_place_positions(jnp.asarray(pos))
             syn0, syn1neg, _loss = runner(
                 syn0, syn1neg, corpus_dev[0], corpus_dev[1],
                 pos_dev, jnp.asarray(lrs, jnp.float32), k)
         table.syn0 = syn0
         table.syn1neg = syn1neg
         return self
+
+    def _sg_positions_device(self, key, n: int, T2: int, B: int):
+        """Device-side shuffled center positions [T2, B] (wrapped to fill
+        the padded scan) — replaces a per-epoch host upload."""
+        fn = getattr(self, "_sg_pos_fn", None)
+        if fn is None:
+            import functools
+
+            @functools.partial(jax.jit, static_argnums=(1, 2, 3))
+            def fn(key, n, T2, B):
+                perm = jax.random.permutation(key, n)
+                reps = -(-T2 * B // n)
+                return jnp.tile(perm, reps)[:T2 * B].reshape(
+                    T2, B).astype(jnp.int32)
+
+            self._sg_pos_fn = fn
+        return self._sg_place_positions(fn(key, n, T2, B))
 
     # hooks for the distributed subclasses (nlp/distributed.py)
     def _sg_round_batch(self, B: int) -> int:

@@ -1,0 +1,163 @@
+"""Multi-conv Pallas megakernel prototype — the ONE measured data point
+BASELINE.md round 4 priced at "~4-6 ms modeled, weeks of work" and round 5
+was asked to replace with data (VERDICT item 7).
+
+Target sequence: the profiled stage-56^2 residual-block boundary that the
+roofline analysis clocks at 73-85% of HBM bandwidth —
+
+    A = relu(bn_scale * (X @ W1) + bn_shift + R)     # block's 1x1 conv3
+    stats = (sum(A), sum(A^2)) per channel           # next BN's one-pass
+    B = A @ W2                                       # next block's 1x1 conv1
+
+At 56^2 both boundary convs of a ResNet-50 bottleneck ARE 1x1 (64->256 and
+256->64); a 1x1 conv over NHWC is exactly a [N*H*W, C] matmul, so this
+chain is the real profiled op sequence minus the 3x3 in the block middle.
+
+What the megakernel buys: XLA must materialize A in HBM between the two
+conv fusions (bf16 [802816, 256] = 411 MB written + 411 MB re-read per
+step at batch 256). The Pallas kernel keeps each row-block's A in VMEM, so
+the intermediate never touches HBM — the only way left to cut traffic on
+an op mix that already runs at the bandwidth roofline.
+
+Round-5 RESULT (measured on the chip, 30-rep medians, bitwise-equal
+outputs):
+
+    BLK      xla       pallas    speedup
+    1024     4.64 ms   5.75 ms   0.81x
+    4096     4.04 ms   5.31 ms   0.76x
+    8192     4.20 ms   5.78 ms   0.73x
+
+LOSER. Even though the kernel provably removes the 822 MB A round trip,
+it runs ~25% SLOWER than XLA's two fusions: Mosaic's block pipeline
+(DMA-in X+R -> MXU dot -> VPU epilogue+stats -> MXU dot -> DMA-out)
+doesn't reach the DMA/compute overlap XLA sustains across its fusion
+boundary, and the f32 A tile plus the blocked residual input limit
+double-buffering depth in VMEM. This retires the multi-conv megakernel
+direction WITH data (BASELINE round-4 priced it "~4-6 ms modeled, weeks
+of work"): the modeled gain assumed HBM traffic was the only cost, and
+the measured prototype shows the kernel-side overheads exceed the
+bandwidth saving on exactly the op mix the roofline flagged.
+
+Run on the TPU:  python experiments/rn50_megakernel.py
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS = 256 * 56 * 56          # batch 256 at stage 56^2
+C_IN, C_MID = 64, 256         # bottleneck conv3: 64 -> 256; next conv1: 256 -> 64
+BLK = 4096
+
+
+def _kernel(x_ref, w1_ref, scale_ref, shift_ref, r_ref, w2_ref,
+            b_ref, s1_ref, s2_ref):
+    i = pl.program_id(0)
+    a = jax.lax.dot_general(
+        x_ref[:], w1_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    a = a * scale_ref[:] + shift_ref[:] + r_ref[:].astype(jnp.float32)
+    a = jnp.maximum(a, 0.0)
+    # one-pass BN stats for the next block, partial per row-block
+    # (whole-array outputs + dynamic row writes: (1, C) blocked specs
+    # violate the Mosaic second-minor-divisible-by-8 rule)
+    s1_ref[pl.ds(i, 1), :] = jnp.sum(a, axis=0)[None]
+    s2_ref[pl.ds(i, 1), :] = jnp.sum(a * a, axis=0)[None]
+    b_ref[:] = jax.lax.dot_general(
+        a.astype(jnp.bfloat16), w2_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+def make_pallas_pair():
+    n_blk = ROWS // BLK
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_blk,),
+        in_specs=[
+            pl.BlockSpec((BLK, C_IN), lambda i: (i, 0)),     # X
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # W1
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # bn scale [1,C]
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # bn shift [1,C]
+            pl.BlockSpec((BLK, C_MID), lambda i: (i, 0)),    # residual
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # W2
+        ],
+        out_specs=[
+            pl.BlockSpec((BLK, C_IN), lambda i: (i, 0)),     # B
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # sum(A) partials
+            pl.BlockSpec(memory_space=pltpu.VMEM),           # sum(A^2)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ROWS, C_IN), jnp.bfloat16),
+            jax.ShapeDtypeStruct((n_blk, C_MID), jnp.float32),
+            jax.ShapeDtypeStruct((n_blk, C_MID), jnp.float32),
+        ],
+    )
+
+
+def main():
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(ROWS, C_IN)).astype(np.float32) * 0.1,
+                    jnp.bfloat16)
+    w1 = jnp.asarray(r.normal(size=(C_IN, C_MID)).astype(np.float32) * 0.05,
+                     jnp.bfloat16)
+    scale = jnp.asarray(r.normal(size=(1, C_MID)).astype(np.float32) * 0.1
+                        + 1.0)
+    shift = jnp.asarray(r.normal(size=(1, C_MID)).astype(np.float32) * 0.1)
+    res = jnp.asarray(r.normal(size=(ROWS, C_MID)).astype(np.float32) * 0.1,
+                      jnp.bfloat16)
+    w2 = jnp.asarray(r.normal(size=(C_MID, C_IN)).astype(np.float32) * 0.05,
+                     jnp.bfloat16)
+
+    @jax.jit
+    def xla_pair(x, w1, scale, shift, res, w2):
+        a = jnp.matmul(x, w1, preferred_element_type=jnp.float32)
+        a = jnp.maximum(a * scale + shift + res.astype(jnp.float32), 0.0)
+        s1 = jnp.sum(a, axis=0)
+        s2 = jnp.sum(a * a, axis=0)
+        b = jnp.matmul(a.astype(jnp.bfloat16), w2,
+                       preferred_element_type=jnp.float32)
+        return b.astype(jnp.bfloat16), s1, s2
+
+    call = make_pallas_pair()
+
+    @jax.jit
+    def pallas_pair(x, w1, scale, shift, res, w2):
+        b, s1, s2 = call(x, w1, scale, shift, res, w2)
+        return b, jnp.sum(s1, axis=0), jnp.sum(s2, axis=0)
+
+    def timeit(fn, tag, reps=30):
+        out = fn(x, w1, scale, shift, res, w2)
+        float(jnp.asarray(out[0]).astype(jnp.float32).sum())
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(x, w1, scale, shift, res, w2)
+        float(jnp.asarray(out[0]).astype(jnp.float32).sum())
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{tag:8s} {dt*1e3:7.3f} ms")
+        return dt, out
+
+    try:
+        t_x, out_x = timeit(xla_pair, "xla")
+        t_p, out_p = timeit(pallas_pair, "pallas")
+        # correctness: same math (bf16 matmuls, f32 accumulate)
+        db = float(jnp.max(jnp.abs(out_x[0].astype(jnp.float32)
+                                   - out_p[0].astype(jnp.float32))))
+        ds = float(jnp.max(jnp.abs(out_x[1] - out_p[1]))
+                   / max(1.0, float(jnp.max(jnp.abs(out_x[1])))))
+        print(f"max|dB|={db:.3e}  rel|dS1|={ds:.3e}")
+        print(f"speedup: {t_x / t_p:.3f}x "
+              f"({'WIN' if t_p < t_x * 0.97 else 'no win'})")
+    except Exception as e:
+        print(f"pallas FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,333 @@
+"""SGNS step implementations, measured against each other on the chip.
+
+The SURVEY §7 phase-7 kernel target: fuse the negative-sampling embedding
+update (gather + dots + sigmoid + scatter-add; `SkipGram.java:156` analog)
+into one Pallas kernel. Round 5 first rebuilt the XLA step scatter-free
+(see `nlp/embeddings.py:_sgns_expected_step` — the shipped path), then
+prototyped the Pallas fusion here so the remaining gap gets DATA, not an
+estimate.
+
+Variants:
+  scatter   — round-4 shipped step (scatter-adds, take_along gathers)
+  dense     — round-5 shipped step (iota-compare cotangent, one-hot
+              matmul scatter, rolled window tables, bf16 sweeps)
+  pallas    — fully fused kernel: syn0/syn1neg VMEM-resident, grid over
+              batch blocks, per-block sequential updates (gather, logits
+              matmul, masked glj reduction, A assembly, both gradient
+              matmuls, in-VMEM scatter) in ONE kernel launch per step
+
+Round-5 verdict (measured on the chip, B=1638 V=10k D=128 W=5):
+
+  scatter   ~1,196 us/step   1.37M words/s   (r4 shipped)
+  dense       ~527 us/step   3.11M words/s   (r5 shipped — 2.3x)
+  pallas      BLOCKED by this env's remote tpu_compile_helper
+
+The kernel's logic is validated in interpret mode (per-block-sequential
+oracle equality on CPU), but every on-chip compile attempt dies with an
+undiagnosable `HTTP 500: tpu_compile_helper subprocess exit code 1`.
+Bisected triggers (each crashes alone; minimal kernels in the round-5
+log): (a) TWO whole-array input_output_aliased VMEM operands; (b) one
+aliased operand >= ~10 MB (the fused [2V, D] table at V=10k); (c) short
+rank-1 VMEM outputs (e.g. [n_blocks] losses); (d) an unrolled chain of
+~10 [B, V]-wide vector updates after a dot_general — even written
+through an in-place VMEM scratch accumulator. (a)-(c) have workarounds
+(fused table, padded 2-D loss rows); (d) is the A-assembly sweep the
+algorithm NEEDS, so the kernel cannot currently be compiled here even at
+V=5000 where everything fits VMEM. Simple gather/scatter/dot kernels
+compile fine (see /tmp-style minimal kernels and the shipped LSTM/BN/
+attention kernels), so this is a compile-helper resource/lowering bug,
+not a VMEM-capacity wall at small V.
+
+Roofline context: the dense XLA step already has the shape the kernel
+was meant to buy — XLA recomputes the logits INSIDE both [B, V] sweeps
+(no 65 MB materialization; verified in the r5 xprof trace), runs the
+matmuls at 130-185 TF/s bf16, and the remaining 527 us/step is ~2 sweep
+passes + 4 matmuls + corpus plumbing. A working kernel's realistic
+ceiling is ~250-350 us/step (the two sweeps are intrinsic to the
+expected-NS objective), i.e. < 2x beyond what the XLA rewrite already
+captured.
+
+Run on the TPU:  python experiments/sgns_kernel_ablate.py
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V, D, B, W, T = 10000, 128, 1638, 5, 120
+K = 5
+BBLK = 64
+
+
+def _pn(r):
+    counts = r.zipf(1.2, V).astype(np.float64)
+    p = counts ** 0.75
+    return (p / p.sum()).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused step
+# ---------------------------------------------------------------------------
+def _sgns_kernel(centers_ref, ctx_ref, vm_ref, lr_ref, pn_ref,
+                 tab_in_ref, tab_ref, loss_ref, vc_ref,
+                 *, n_blk, two_w, k_neg):
+    # tab holds BOTH tables in one aliased VMEM buffer (two separate
+    # whole-array aliased VMEM operands crash this env's remote
+    # tpu_compile_helper — bisected in round 5): rows [0, V) = syn0,
+    # rows [V, 2V) = syn1neg
+    del tab_in_ref
+    s0_ref = tab_ref
+    i = pl.program_id(0)
+    lr = lr_ref[0]
+    bblk = vm_ref.shape[0]
+    base = i * bblk
+
+    # gather vc rows from VMEM-resident syn0 (sequential dynamic slices)
+    def gather(r, _):
+        vc_ref[pl.ds(r, 1), :] = s0_ref[pl.ds(centers_ref[base + r], 1), :]
+        return 0
+    jax.lax.fori_loop(0, bblk, gather, 0)
+    vc = vc_ref[:]
+
+    n_vocab = tab_ref.shape[0] // 2
+    s1n = tab_ref[pl.ds(n_vocab, n_vocab), :]
+    logits = jax.lax.dot_general(
+        vc.astype(jnp.bfloat16), s1n.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [b, V] f32
+    sg = jax.nn.sigmoid(logits)
+    pn = pn_ref[:].astype(jnp.bfloat16)
+    neg_vec = jax.lax.dot_general(
+        jax.nn.log_sigmoid(-logits).astype(jnp.bfloat16), pn.reshape(V, 1),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)[:, 0]
+    nvalid = jnp.sum(vm_ref[:], axis=1)
+    neg_l = jnp.sum(k_neg * nvalid * neg_vec)
+
+    viota = jax.lax.broadcasted_iota(jnp.int32, (1, V), 1)
+    a = ((k_neg * nvalid)[:, None]
+         * (pn_ref[:][None, :] * sg)).astype(jnp.bfloat16)
+    pos_l = jnp.float32(0.0)
+    for j in range(two_w):
+        eq = ctx_ref[:, j:j + 1] == viota
+        glj = jnp.sum(logits * eq.astype(jnp.float32), axis=1)
+        pos_l = pos_l + jnp.sum(jax.nn.log_sigmoid(glj) * vm_ref[:, j])
+        wj = (jax.nn.sigmoid(-glj) * vm_ref[:, j]).astype(jnp.bfloat16)
+        a = a - wj[:, None] * eq.astype(jnp.bfloat16)
+
+    gvc = jax.lax.dot_general(
+        a, s1n.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [b, D]
+    gs1n = jax.lax.dot_general(
+        a, vc.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [V, D]
+    tab_ref[pl.ds(n_vocab, n_vocab), :] = s1n - lr * gs1n
+
+    vc_ref[:] = lr * gvc   # reuse the gather scratch as the update buffer
+
+    def scatter(r, _):
+        row = s0_ref[pl.ds(centers_ref[base + r], 1), :]
+        s0_ref[pl.ds(centers_ref[base + r], 1), :] = row - vc_ref[pl.ds(r, 1), :]
+        return 0
+    jax.lax.fori_loop(0, bblk, scatter, 0)
+    # rank-1 short VMEM outputs also crash the remote compile
+    # helper; a (1, 128) row per block is the workaround
+    loss_ref[pl.ds(i, 1), :] = jnp.broadcast_to(-(pos_l + neg_l), (1, 128))
+
+
+def make_pallas_step(pn, two_w):
+    n_blk = -(-B // BBLK)
+    bpad = n_blk * BBLK
+    kern = functools.partial(_sgns_kernel, n_blk=n_blk, two_w=two_w,
+                             k_neg=float(K))
+    call = pl.pallas_call(
+        kern,
+        grid=(n_blk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # centers (all)
+            pl.BlockSpec((BBLK, two_w), lambda i: (i, 0)),  # ctx
+            pl.BlockSpec((BBLK, two_w), lambda i: (i, 0)),  # vm
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # lr
+            pl.BlockSpec(memory_space=pltpu.VMEM),          # pn
+            pl.BlockSpec(memory_space=pltpu.VMEM),          # tab (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),          # tab
+            pl.BlockSpec(memory_space=pltpu.VMEM),          # loss rows
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((2 * V, D), jnp.float32),
+            jax.ShapeDtypeStruct((n_blk, 128), jnp.float32),
+        ],
+        input_output_aliases={5: 0},
+        scratch_shapes=[pltpu.VMEM((BBLK, D), jnp.float32)],
+    )
+
+    def step(tab, centers, ctx, vm, lr):
+        pad = bpad - centers.shape[0]
+        centers = jnp.pad(centers, (0, pad))
+        ctx = jnp.pad(ctx, ((0, pad), (0, 0)))
+        vm = jnp.pad(vm, ((0, pad), (0, 0)))     # pad rows fully masked
+        tab, losses = call(centers, ctx, vm, lr.reshape(1), pn, tab)
+        return tab, jnp.sum(losses[:, 0])
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+def main():
+    r = np.random.default_rng(0)
+    sys.path.insert(0, "/root/repo")
+    from deeplearning4j_tpu.nlp.embeddings import (NegativeSampler,
+                                                   _sgns_expected_step,
+                                                   _sgns_expected_step_scatter,
+                                                   make_skipgram_corpus_runner)
+
+    corpus = jnp.asarray(r.integers(0, V, 200_000).astype(np.int32))
+    sid = jnp.asarray((np.arange(200_000) // 20).astype(np.int32))
+    positions = jnp.asarray(r.integers(0, 200_000, (T, B)).astype(np.int32))
+    lrs = jnp.full((T,), 0.025, jnp.float32)
+    counts = r.zipf(1.2, V).astype(np.float64)
+
+    class Tbl:
+        pass
+    table = Tbl()
+    table.vector_length = D
+    table.negative = K
+    table.sampler = NegativeSampler(counts)
+    pn = table.sampler.probs
+
+    def time_runner(run, tag, reps=20):
+        syn0 = jnp.asarray(r.normal(size=(V, D)).astype(np.float32) * 0.01)
+        syn1n = jnp.zeros((V, D), jnp.float32)
+        rng = jax.random.PRNGKey(0)
+        s0, s1n, _ = run(syn0, syn1n, corpus, sid, positions, lrs, rng)
+        float(s0.sum())
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s0, s1n, _ = run(s0, s1n, corpus, sid, positions, lrs, rng)
+        float(s0.sum())
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{tag:10s} {dt / T * 1e6:8.1f} us/step   "
+              f"{T * B / dt:12,.0f} words/s")
+        return dt
+
+    # round-4 scatter formulation, in the same harness (window gathers in
+    # the scan body as r4 had them)
+    offs_r4 = jnp.asarray(list(range(-W, 0)) + list(range(1, W + 1)))
+    pn_dev = jnp.asarray(table.sampler.probs)
+
+    @jax.jit
+    def run_scatter(syn0, syn1neg, corpus, sid, positions, lrs, rng):
+        n = corpus.shape[0]
+
+        def body(carry, inp):
+            s0, s1n = carry
+            pos, lr, k = inp
+            b = jax.random.randint(k, pos.shape, 1, W + 1)
+            j = pos[:, None] + offs_r4[None, :]
+            jc = jnp.clip(j, 0, n - 1)
+            valid = ((j >= 0) & (j < n)
+                     & (jnp.abs(offs_r4)[None, :] <= b[:, None])
+                     & (sid[jc] == sid[pos][:, None]))
+            centers = corpus[pos]
+            ctx = corpus[jc]
+            vm = valid.astype(jnp.float32)
+            nvalid = jnp.sum(vm, axis=1)
+            vc0 = s0[centers]
+            loss, gvc, gs1n = _sgns_expected_step_scatter(
+                vc0, s1n, ctx, vm, nvalid, pn_dev, K)
+            s0 = s0.at[centers].add(-lr * gvc)
+            return (s0, s1n - lr * gs1n), loss
+
+        keys = jax.random.split(rng, positions.shape[0])
+        (syn0, syn1neg), losses = jax.lax.scan(
+            body, (syn0, syn1neg), (positions, lrs, keys))
+        return syn0, syn1neg, jnp.mean(losses)
+
+    time_runner(run_scatter, "scatter")
+
+    # shipped dense step (already wired into make_skipgram_corpus_runner)
+    run_dense = make_skipgram_corpus_runner(table, W)
+    time_runner(run_dense, "dense")
+
+    # pallas fused step in the same scan harness
+    pstep = make_pallas_step(jnp.asarray(pn), 2 * W)
+    offs_list = list(range(-W, 0)) + list(range(1, W + 1))
+    offs = jnp.asarray(offs_list)
+
+    @jax.jit
+    def run_pallas(syn0, syn1neg, corpus, sid, positions, lrs, rng):
+        n = corpus.shape[0]
+        ctx_tab = jnp.stack([jnp.roll(corpus, -o) for o in offs_list], axis=1)
+        sid_tab = jnp.stack([jnp.roll(sid, -o) for o in offs_list], axis=1)
+
+        def body(tab, inp):
+            pos, lr, k = inp
+            b = jax.random.randint(k, pos.shape, 1, W + 1)
+            j = pos[:, None] + offs[None, :]
+            valid = ((j >= 0) & (j < n)
+                     & (jnp.abs(offs)[None, :] <= b[:, None])
+                     & (sid_tab[pos] == sid[pos][:, None]))
+            vm = valid.astype(jnp.float32)
+            tab, loss = pstep(tab, corpus[pos], ctx_tab[pos], vm, lr)
+            return tab, loss
+
+        keys = jax.random.split(rng, positions.shape[0])
+        tab, losses = jax.lax.scan(
+            body, jnp.concatenate([syn0, syn1neg], axis=0),
+            (positions, lrs, keys))
+        return tab[:V], tab[V:], jnp.mean(losses)
+
+    try:
+        time_runner(run_pallas, "pallas")
+    except Exception as e:
+        print(f"pallas     FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+    # correctness spot-check: one pallas step vs the scatter oracle
+    # (pallas updates BBLK-blocks sequentially; the oracle is applied in
+    # the same block order)
+    rr = np.random.default_rng(1)
+    s0 = jnp.asarray(rr.normal(size=(V, D)).astype(np.float32) * 0.05)
+    s1n = jnp.asarray(rr.normal(size=(V, D)).astype(np.float32) * 0.05)
+    centers = jnp.asarray(rr.integers(0, V, B).astype(np.int32))
+    ctx = jnp.asarray(rr.integers(0, V, (B, 2 * W)).astype(np.int32))
+    vm = jnp.asarray((rr.random((B, 2 * W)) > 0.3).astype(np.float32))
+    nvalid = vm.sum(axis=1)
+    lr = jnp.float32(0.025)
+    try:
+        tab, _ = pstep(jnp.concatenate([s0, s1n], axis=0), centers, ctx,
+                       vm, lr)
+        p0, p1n = tab[:V], tab[V:]
+        o0, o1n = np.asarray(s0), np.asarray(s1n)
+        for lo in range(0, B, BBLK):
+            hi = min(lo + BBLK, B)
+            sl = slice(lo, hi)
+            vc = o0[centers[sl]]
+            _, gvc, gs1n = _sgns_expected_step_scatter(
+                jnp.asarray(vc), jnp.asarray(o1n), ctx[sl], vm[sl],
+                nvalid[sl], jnp.asarray(pn.astype(np.float32)), float(K))
+            gvc, gs1n = np.asarray(gvc), np.asarray(gs1n)
+            np.subtract.at(o0, np.asarray(centers[sl]),
+                           float(lr) * gvc)
+            o1n = o1n - float(lr) * gs1n
+        e0 = float(np.max(np.abs(np.asarray(p0) - o0)))
+        e1 = float(np.max(np.abs(np.asarray(p1n) - o1n)))
+        print(f"pallas-vs-oracle max|d| syn0={e0:.3e} syn1neg={e1:.3e} "
+              f"(bf16 sweeps => ~1e-2 scale expected)")
+    except Exception as e:
+        print(f"oracle check FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -302,3 +302,31 @@ def test_inverted_index_search_and_phrase():
 
     batches = list(idx.batch_iter(2))
     assert [len(b) for b in batches] == [2, 1]
+
+
+def test_sgns_dense_step_matches_scatter_oracle():
+    """Round-5 scatter-free expected-NS step (iota-compare cotangent, MXU
+    one-hot updates) == the r4 scatter formulation, in f64 (the f64 path
+    skips the bf16 sweep storage, so this is a tight equality)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.embeddings import (
+        _sgns_expected_step, _sgns_expected_step_scatter)
+
+    r = np.random.default_rng(0)
+    B, V, D, W2, K = 37, 211, 16, 6, 5
+    vc = jnp.asarray(r.normal(size=(B, D)))
+    s1n = jnp.asarray(r.normal(size=(V, D)))
+    ctx = jnp.asarray(r.integers(0, V, (B, W2)).astype(np.int32))
+    vm = jnp.asarray((r.random((B, W2)) > 0.3).astype(np.float64))
+    nvalid = vm.sum(axis=1)
+    pn = r.random(V)
+    pn = jnp.asarray(pn / pn.sum())
+    l1, g1, h1 = _sgns_expected_step(vc, s1n, ctx, vm, nvalid, pn, float(K))
+    l2, g2, h2 = _sgns_expected_step_scatter(vc, s1n, ctx, vm, nvalid, pn,
+                                             float(K))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-9,
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-9,
+                               atol=1e-12)

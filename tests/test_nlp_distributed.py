@@ -115,3 +115,35 @@ def test_distributed_glove_rejects_indivisible_batch():
                          min_word_frequency=1, seed=2)
     with pytest.raises(ValueError, match="not divisible"):
         g.fit()
+
+
+def test_distributed_exporter_spi(tmp_path):
+    """SparkModelExporter analog (round-5 VERDICT item 8): the trained
+    model is pushed through the configured exporter when fit() completes —
+    VocabCacheExporter (in-memory) and HdfsModelExporter (file via
+    WordVectorSerializer) analogs."""
+    from deeplearning4j_tpu.nlp.distributed import (FileModelExporter,
+                                                    InMemoryExporter)
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+    sents = [f"alpha beta gamma delta w{i % 7}" for i in range(200)]
+    mem = InMemoryExporter()
+    w2v = DistributedWord2Vec(
+        mesh=make_mesh({"data": 8}),
+        sentence_iterator=CollectionSentenceIterator(sents),
+        layer_size=16, window_size=2, negative=3, epochs=1,
+        min_word_frequency=1, seed=1, exporter=mem)
+    w2v.fit()
+    assert mem.word_vectors is not None
+    assert mem.vocab is w2v.vocab
+    v = mem.word_vectors.word_vector("alpha")
+    np.testing.assert_allclose(v, w2v.lookup_table.vector("alpha"))
+
+    # file exporter streams through the serializer; round-trip restores
+    path = str(tmp_path / "vecs.txt")
+    w2v.set_exporter(FileModelExporter(path, fmt="text"))
+    w2v.fit()
+    back = WordVectorSerializer.read_word_vectors(path)
+    np.testing.assert_allclose(back.word_vector("alpha"),
+                               w2v.lookup_table.vector("alpha"), rtol=1e-4,
+                               atol=1e-6)
