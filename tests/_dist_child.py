@@ -99,6 +99,36 @@ def main():
         f.write(repr(trainer2.score(ds)))
     print(f"proc {pid} replicated-eval done")
 
+    # UNEQUAL per-process batch counts through the per-batch lockstep
+    # gather (review r5: exhausted processes must keep participating with
+    # empty shares instead of desynchronizing the collective into a hang):
+    # proc 0 iterates TWO local-shard batches, proc 1 only ONE
+    from deeplearning4j_tpu.datasets.export import LocalShardDataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    half = 64 // n_procs
+    lo, hi = pid * half, (pid + 1) * half
+    batches = [LocalShardDataSet(x[lo:hi], y[lo:hi])]
+    extra_ref = (np.arange(8, dtype=np.float32)[None].repeat(16, 0),
+                 np.eye(4, dtype=np.float32)[np.zeros(16, np.int64)])
+    if pid == 0:
+        batches.append(LocalShardDataSet(*extra_ref))
+    scores_uneq = trainer2.score_examples(ListDataSetIterator(batches),
+                                          add_regularization_terms=True)
+    # batch 1 gathers both processes' shards (64 rows, original order);
+    # batch 2 only proc 0's 16 extra rows — identical on every process
+    assert scores_uneq.shape == (80,), scores_uneq.shape
+    np.testing.assert_allclose(scores_uneq[:64], scores, rtol=0, atol=0)
+    # value correctness of the exhausted-process round, not just identity:
+    # the 16 extra rows are identical inputs, and must equal the model's
+    # own single-device per-example score for that batch
+    ref_extra = model2.score_examples(
+        DataSet(extra_ref[0], extra_ref[1]), add_regularization_terms=True)
+    np.testing.assert_allclose(scores_uneq[64:], ref_extra, rtol=2e-6,
+                               atol=1e-8)
+    np.save(f"{outdir}/scores_uneq_p{pid}.npy", scores_uneq)
+    print(f"proc {pid} unequal-batch lockstep done")
+
     # --- cross-node time source (NTPTimeSource analog) across the REAL
     # process boundary: proc 0 hosts the reference clock; proc 1 aligns
     # its stats stamps through the NTP exchange --------------------------

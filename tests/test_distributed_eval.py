@@ -21,6 +21,7 @@ from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, DataSet,
                                 MultiLayerNetwork, NeuralNetConfiguration,
                                 OutputLayer, RnnOutputLayer, Sgd,
                                 VariationalAutoencoder)
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.parallel import (ParallelTrainer, ShardingStrategy,
                                          TrainingMode, make_mesh)
@@ -299,3 +300,127 @@ def test_graph_parallel_evaluate_and_score_examples():
     np.testing.assert_allclose(
         trainer.score_examples(ds, True), single.score_examples(ds, True),
         rtol=1e-6, atol=1e-9)
+
+
+def test_parallel_evaluate_masked_rnn_matches_single():
+    """Mesh evaluation of masked time-series data == single device,
+    count-exact (the pad-and-slice path must not disturb mask handling)."""
+    r = np.random.default_rng(11)
+    B, T, F, C = 20, 7, 5, 3
+    x = r.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[r.integers(0, C, (B, T))]
+    lm = (r.random((B, T)) > 0.35).astype(np.float32)
+    lm[:, 0] = 1.0
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+            .list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    single = MultiLayerNetwork(conf).init()
+    multi = MultiLayerNetwork(conf).init()
+    multi.params = single.params
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    it = lambda: ListDataSetIterator(
+        [DataSet(x[:12], y[:12], labels_mask=lm[:12]),
+         DataSet(x[12:], y[12:], labels_mask=lm[12:])])
+    ev_s = single.evaluate(it())
+    ev_m = trainer.evaluate(it())
+    np.testing.assert_array_equal(ev_m.confusion.matrix,
+                                  ev_s.confusion.matrix)
+    # masked entries excluded on both paths
+    assert ev_m.num_examples() == int(lm.sum())
+    # per-example scoring agrees too (masked + time-summed)
+    np.testing.assert_allclose(
+        trainer.score_examples(DataSet(x, y, labels_mask=lm), False),
+        single.score_examples(DataSet(x, y, labels_mask=lm), False),
+        rtol=1e-6, atol=1e-9)
+
+
+def test_parallel_dp_exotic_layers_match_single():
+    """dp == single for layer families the parallel suites never covered
+    (Embedding, CenterLoss head, supervised VAE encoder) — the
+    registry-training-sweep idea extended to the sharded step."""
+    from deeplearning4j_tpu import CenterLossOutputLayer, EmbeddingLayer
+
+    r = np.random.default_rng(13)
+    cases = []
+    xe = r.integers(0, 30, (32, 1)).astype(np.float32)
+    ye = np.eye(4, dtype=np.float32)[r.integers(0, 4, 32)]
+    cases.append((
+        lambda: (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
+                 .list()
+                 .layer(EmbeddingLayer(n_in=30, n_out=8))
+                 .layer(OutputLayer(n_out=4, loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(1)).build()),
+        xe, ye))
+    xc = r.normal(size=(32, 10)).astype(np.float32)
+    cases.append((
+        lambda: (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.1))
+                 .list()
+                 .layer(DenseLayer(n_out=8, activation="tanh"))
+                 .layer(CenterLossOutputLayer(n_out=4, loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(10)).build()),
+        xc, ye))
+    cases.append((
+        lambda: (NeuralNetConfiguration.builder().seed(6).updater(Sgd(0.1))
+                 .list()
+                 .layer(VariationalAutoencoder(
+                     n_out=4, encoder_layer_sizes=(8,),
+                     decoder_layer_sizes=(8,), activation="tanh"))
+                 .layer(OutputLayer(n_out=4, loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(10)).build()),
+        xc, ye))
+    for build, x, y in cases:
+        single = MultiLayerNetwork(build()).init()
+        multi = MultiLayerNetwork(build()).init()
+        ds = DataSet(x, y)
+        trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                                  mode=TrainingMode.SYNC)
+        for _ in range(3):
+            single.fit(ds)
+            trainer.fit(ds)
+        np.testing.assert_allclose(multi.params_flat(),
+                                   single.params_flat(), rtol=2e-5,
+                                   atol=1e-6)
+
+
+def test_graph_multidataset_parallel_evaluate_and_score():
+    """Multi-input ComputationGraph (MergeVertex) through the mesh
+    evaluation plane on MultiDataSet batches."""
+    from deeplearning4j_tpu.datasets.iterators import MultiDataSet
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+
+    def build():
+        b = (NeuralNetConfiguration.builder().seed(23).updater(Sgd(0.1))
+             .graph_builder())
+        b.add_inputs("a", "b")
+        b.add_layer("ha", DenseLayer(n_out=8, activation="tanh"), "a")
+        b.add_layer("hb", DenseLayer(n_out=8, activation="tanh"), "b")
+        b.add_vertex("m", MergeVertex(), "ha", "hb")
+        b.add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "m")
+        b.set_outputs("out")
+        b.set_input_types(InputType.feed_forward(5),
+                          InputType.feed_forward(7))
+        return ComputationGraph(b.build()).init()
+
+    r = np.random.default_rng(4)
+    xa = r.normal(size=(44, 5)).astype(np.float32)
+    xb = r.normal(size=(44, 7)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 44)]
+    mds = MultiDataSet(features=[xa, xb], labels=[y])
+    single, multi = build(), build()
+    trainer = ParallelTrainer(multi, mesh=make_mesh({"data": 8}),
+                              mode=TrainingMode.SYNC)
+    single.fit(MultiDataSet(features=[xa[:32], xb[:32]], labels=[y[:32]]))
+    trainer.fit(MultiDataSet(features=[xa[:32], xb[:32]], labels=[y[:32]]))
+    ev_s = single.evaluate(ListDataSetIterator([mds]))
+    ev_m = trainer.evaluate(mds)   # 44 rows: uneven over 8 -> pad path
+    np.testing.assert_array_equal(ev_m.confusion.matrix,
+                                  ev_s.confusion.matrix)
+    assert ev_m.num_examples() == 44
+    np.testing.assert_allclose(
+        trainer.score_examples(mds, True),
+        single.score_examples(mds, True), rtol=1e-6, atol=1e-9)

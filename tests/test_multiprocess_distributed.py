@@ -97,6 +97,12 @@ def test_two_process_training_matches_single_process(tmp_path):
     sc1 = (tmp_path / "score_p1.txt").read_text()
     assert sc0 == sc1
     np.testing.assert_allclose(float(sc0), single.score(ds), rtol=2e-5)
+    # unequal per-process batch counts: identical gathered rows on both
+    # processes (no lockstep desync)
+    u0 = np.load(tmp_path / "scores_uneq_p0.npy")
+    u1 = np.load(tmp_path / "scores_uneq_p1.npy")
+    np.testing.assert_allclose(u0, u1, rtol=0, atol=0)
+    assert u0.shape == (80,)
 
     # time-source tier crossed the process boundary: both processes
     # produced offset-corrected stamps on one timeline (same host here,
