@@ -1,0 +1,162 @@
+"""Graph-vertex TRAINING smoke sweep — the ComputationGraph counterpart of
+tests/test_registry_training_sweep.py: every vertex type executes inside a
+trained DAG for two full fit() steps (forward through the vertex,
+gradients through `jax.grad`, tree-aware updater), asserting finite score
+and per-layer param movement. Catches BiLSTM-class latent bugs (training
+path broken while gradcheck-only coverage stays green) for the vertex
+tier."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, GravesLSTM,
+                                InputType, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.datasets.iterators import MultiDataSet
+from deeplearning4j_tpu.nn.conf.graph import (DuplicateToTimeSeriesVertex,
+                                              ElementWiseVertex, L2Vertex,
+                                              L2NormalizeVertex,
+                                              LastTimeStepVertex,
+                                              MergeVertex,
+                                              PreprocessorVertex,
+                                              ScaleVertex, ShiftVertex,
+                                              StackVertex, SubsetVertex,
+                                              UnstackVertex)
+from deeplearning4j_tpu.nn.conf.preprocessors import FeedForwardToRnnPreProcessor
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+FF = InputType.feed_forward(6)
+RNN = InputType.recurrent(5)
+
+
+def _ff_data(n=16, f=6, c=3, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[r.integers(0, c, n)]
+    return x, y
+
+
+def _rnn_data(n=8, t=4, f=5, c=3, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, t, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[r.integers(0, c, (n, t))]
+    return x, y
+
+
+def _two_branch(vertex, ff_head=True):
+    """in -> (ha, hb) -> vertex -> out"""
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("ha", DenseLayer(n_out=6, activation="tanh"), "in")
+    b.add_layer("hb", DenseLayer(n_out=6, activation="tanh"), "in")
+    b.add_vertex("v", vertex, "ha", "hb")
+    b.add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "v")
+    b.set_outputs("out")
+    b.set_input_types(FF)
+    return ComputationGraph(b.build()).init(), DataSet(*_ff_data())
+
+
+def _chain(vertex, pre_layer=None, input_type=FF, data=None, head=None):
+    """in -> (layer) -> vertex -> out"""
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("in")
+    prev = "in"
+    if pre_layer is not None:
+        b.add_layer("h", pre_layer, "in")
+        prev = "h"
+    b.add_vertex("v", vertex, prev)
+    b.add_layer("out", head or OutputLayer(n_out=3, loss="mcxent"), "v")
+    b.set_outputs("out")
+    b.set_input_types(input_type)
+    return (ComputationGraph(b.build()).init(),
+            data or DataSet(*_ff_data()))
+
+
+def _cases():
+    rnn_x, _ = _rnn_data()
+    ff_x, _ = _ff_data()
+    yield "MergeVertex", lambda: _two_branch(MergeVertex())
+    yield "ElementWiseVertex-add", lambda: _two_branch(
+        ElementWiseVertex("add"))
+    yield "ElementWiseVertex-product", lambda: _two_branch(
+        ElementWiseVertex("product"))
+    yield "ElementWiseVertex-max", lambda: _two_branch(
+        ElementWiseVertex("max"))
+    yield "SubsetVertex", lambda: _chain(
+        SubsetVertex(0, 3), DenseLayer(n_out=6, activation="tanh"))
+    yield "ScaleVertex", lambda: _chain(
+        ScaleVertex(0.5), DenseLayer(n_out=6, activation="tanh"))
+    yield "ShiftVertex", lambda: _chain(
+        ShiftVertex(1.0), DenseLayer(n_out=6, activation="tanh"))
+    yield "L2NormalizeVertex", lambda: _chain(
+        L2NormalizeVertex(), DenseLayer(n_out=6, activation="tanh"))
+    yield "L2Vertex", lambda: _two_branch(L2Vertex())
+    yield "StackUnstack", lambda: _stack_unstack()
+    yield "PreprocessorVertex", lambda: _chain(
+        PreprocessorVertex(FeedForwardToRnnPreProcessor()),
+        DenseLayer(n_out=5, activation="tanh"),
+        FF,
+        DataSet(ff_x, np.eye(3, dtype=np.float32)[
+            np.random.default_rng(1).integers(0, 3, (16, 1))]),
+        RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+    yield "LastTimeStepVertex", lambda: _chain(
+        LastTimeStepVertex(), GravesLSTM(n_out=6, activation="tanh"),
+        RNN, DataSet(rnn_x, np.eye(3, dtype=np.float32)[
+            np.random.default_rng(2).integers(0, 3, 8)]))
+    yield "DuplicateToTimeSeriesVertex", lambda: _dup_tts()
+
+
+def _stack_unstack():
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("ha", DenseLayer(n_out=6, activation="tanh"), "in")
+    b.add_layer("hb", DenseLayer(n_out=6, activation="tanh"), "in")
+    b.add_vertex("st", StackVertex(), "ha", "hb")
+    b.add_vertex("u0", UnstackVertex(0, 2), "st")
+    b.add_vertex("u1", UnstackVertex(1, 2), "st")
+    b.add_vertex("m", MergeVertex(), "u0", "u1")
+    b.add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "m")
+    b.set_outputs("out")
+    b.set_input_types(FF)
+    return ComputationGraph(b.build()).init(), DataSet(*_ff_data())
+
+
+def _dup_tts():
+    """seq input + ff context duplicated over time, merged per-step."""
+    rnn_x, rnn_y = _rnn_data()
+    ctx = np.random.default_rng(3).normal(size=(8, 6)).astype(np.float32)
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("seq", "ctx")
+    b.add_layer("rec", GravesLSTM(n_out=6, activation="tanh"), "seq")
+    b.add_layer("cd", DenseLayer(n_out=6, activation="tanh"), "ctx")
+    b.add_vertex("dup", DuplicateToTimeSeriesVertex(), "cd", "seq")
+    b.add_vertex("m", MergeVertex(), "rec", "dup")
+    b.add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"), "m")
+    b.set_outputs("out")
+    b.set_input_types(InputType.recurrent(5), InputType.feed_forward(6))
+    return (ComputationGraph(b.build()).init(),
+            MultiDataSet(features=[rnn_x, ctx], labels=[rnn_y]))
+
+
+@pytest.mark.parametrize("name,build", list(_cases()))
+def test_vertex_type_trains(name, build):
+    import jax
+
+    net, ds = build()
+    before = {k: jax.tree_util.tree_map(
+        lambda a: np.asarray(a).copy(), v) for k, v in net.params.items()}
+    net.fit(ds)
+    net.fit(ds)
+    assert np.isfinite(float(net.score())), name
+    for vname, b in before.items():
+        b_leaves = jax.tree_util.tree_leaves(b)
+        a_leaves = jax.tree_util.tree_leaves(net.params[vname])
+        if not b_leaves:
+            continue
+        moved = any(float(np.max(np.abs(np.asarray(al) - bl))) > 0.0
+                    for bl, al in zip(b_leaves, a_leaves))
+        assert moved, f"{name}: vertex {vname!r} params did not move"
