@@ -25,9 +25,12 @@ import time
 def bench_word2vec(n_sentences=100000, sent_len=20, vocab=10000, epochs=1,
                    batch_words=8192):
     """words/sec for batched skip-gram negative sampling (BASELINE #4) on a
-    synthetic zipf corpus (throughput; accuracy is covered by tests/test_nlp)."""
+    synthetic zipf corpus (throughput; accuracy is covered by tests/test_nlp).
+    Runs under its own telemetry session so the returned dict attributes
+    compile count and host/device time split to THIS bench alone."""
     import numpy as np
 
+    from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.nlp.sentence_iterator import (
         CollectionSentenceIterator)
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
@@ -49,23 +52,32 @@ def bench_word2vec(n_sentences=100000, sent_len=20, vocab=10000, epochs=1,
         float(jnp.asarray(w2v.lookup_table.syn0).sum())
 
     total_words = n_sentences * sent_len * epochs
-    t0 = time.perf_counter()
-    w2v.fit()
-    sync()
-    cold = total_words / (time.perf_counter() - t0)
-    # steady-state: epoch runner + flattened corpus are cached -> measures
-    # the device SGNS epoch itself (the host tokenize/flatten is paid once,
-    # exactly as an epochs=N fit pays it). Median of 3 in-process reps,
-    # spread recorded (round-5 reporting contract: BENCH and BASELINE
-    # agree by construction; the spread makes a load-contaminated capture
-    # diagnosable from the artifact alone)
-    warms = []
-    for _ in range(3):
+    with telemetry.enabled() as sess:
         t0 = time.perf_counter()
         w2v.fit()
         sync()
-        warms.append(total_words / (time.perf_counter() - t0))
-    return cold, warms
+        cold = total_words / (time.perf_counter() - t0)
+        # steady-state: epoch runner + flattened corpus are cached ->
+        # measures the device SGNS epoch itself (the host tokenize/flatten
+        # is paid once, exactly as an epochs=N fit pays it). Median of 3
+        # in-process reps, spread recorded (round-5 reporting contract:
+        # BENCH and BASELINE agree by construction; the spread makes a
+        # load-contaminated capture diagnosable from the artifact alone)
+        warms = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            w2v.fit()
+            sync()
+            warms.append(total_words / (time.perf_counter() - t0))
+        spans = sess.span_totals()
+        tel = {"xla_compilations": sess.compiles.total(),
+               "compiles": {k: v["count"]
+                            for k, v in sess.compiles.report().items()},
+               "host_flatten_s": round(spans.get("host/flatten_corpus", 0.0),
+                                       4),
+               "device_dispatch_s": round(spans.get("device/dispatch", 0.0),
+                                          4)}
+    return cold, warms, tel
 
 
 def bench_scaling(devices=8):
@@ -121,12 +133,17 @@ def main():
     from deeplearning4j_tpu.util.platform import enable_compilation_cache
     enable_compilation_cache()   # reuse XLA executables across bench runs
 
+    from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.models.zoo import (bench_char_rnn, bench_lenet,
                                                bench_resnet50)
 
     from deeplearning4j_tpu.models.zoo import (bench_char_rnn_dispatch,
                                                bench_lenet_dispatch)
 
+    # process-wide session (async: no per-step syncs, so the headline
+    # numbers are undisturbed); every benchmark line now carries
+    # extras.telemetry — compile counts, host/device span split, peak RSS
+    session = telemetry.enable()
     extras = {}
     # every headline = median of 3 in-process reps, spread recorded
     # (*-spread) — the round-5 BENCH/BASELINE agreement contract
@@ -148,19 +165,24 @@ def main():
     extras["charRNN-tokens-dispatch"] = round(rnn_d, 1)
     extras["charRNN-tokens-dispatch-spread"] = sp
     try:
-        w2v_cold, warms = bench_word2vec()
+        w2v_cold, warms, w2v_tel = bench_word2vec()
         extras["Word2Vec-SGNS-words"] = round(w2v_cold, 1)
         warms = sorted(warms)
         extras["Word2Vec-SGNS-words-steady"] = round(warms[len(warms) // 2],
                                                      1)
         extras["Word2Vec-SGNS-words-steady-spread"] = [round(warms[0], 1),
                                                        round(warms[-1], 1)]
+        extras["Word2Vec-SGNS-telemetry"] = w2v_tel
     except Exception as e:  # keep the headline alive if NLP bench breaks
         extras["Word2Vec-SGNS-words"] = f"error: {type(e).__name__}"
     try:
         sc = bench_scaling(8)
         if sc:
             extras["DP-strong-scaling-8dev"] = sc["efficiency"]
+            # multichip compile-count + sync-time attribution (the
+            # subprocess runs its own telemetry session)
+            if sc.get("telemetry"):
+                extras["DP-telemetry"] = sc["telemetry"]
             extras["DP-strong-scaling-8dev-spread"] = sc.get(
                 "efficiency_spread")
             # per-phase decomposition so an inverted/contaminated capture
@@ -205,6 +227,8 @@ def main():
     except Exception:
         pass
     vs = resnet_sps / baseline if baseline else 1.0
+    extras["telemetry"] = session.summary()
+    telemetry.disable()
     print(json.dumps({
         "metric": "samples/sec/chip (ResNet50-ImageNet, bf16 b256)",
         "value": round(resnet_sps, 2),

@@ -45,6 +45,8 @@ from .datasets import (ArrayDataSetIterator, DataSet, DataSetIterator,
                        MultiDataSet)
 from .eval import (Evaluation, ROC, ROCMultiClass, RegressionEvaluation)
 from .util import GradientCheckUtil, ModelSerializer
+from . import telemetry
+from .telemetry import TelemetryListener, TelemetrySession
 
 __all__ = [
     "BackpropType", "GradientNormalization", "InputType",
@@ -71,4 +73,5 @@ __all__ = [
     "ArrayDataSetIterator", "DataSet", "DataSetIterator", "MultiDataSet",
     "Evaluation", "ROC", "ROCMultiClass", "RegressionEvaluation",
     "GradientCheckUtil", "ModelSerializer",
+    "telemetry", "TelemetryListener", "TelemetrySession",
 ]

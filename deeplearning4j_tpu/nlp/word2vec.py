@@ -34,6 +34,8 @@ from .sentence_iterator import (BasicLabelAwareIterator, LabelAwareIterator,
                                 LabelsSource, SentenceIterator)
 from .tokenization import DefaultTokenizerFactory, TokenizerFactory
 from .vocab import VocabCache, VocabConstructor, VocabWord
+from ..telemetry.compile_watch import watch_compiles
+from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -273,9 +275,12 @@ class SequenceVectors(WordVectorsModel):
         if syn1neg is None:
             syn1neg = jnp.zeros((1, 1), jnp.float32)
 
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         runners = {}
         for epoch in range(self.epochs):
-            pairs = self._gen_pairs(seqs)
+            with span("host/pair_gen"):
+                pairs = self._gen_pairs(seqs)
             tasks = []
             if "sg" in pairs:
                 tasks.append(("sg", sg_step) + pairs["sg"])
@@ -320,13 +325,16 @@ class SequenceVectors(WordVectorsModel):
                 keys = jax.random.split(k, T2)
                 runner = runners.get(kind)
                 if runner is None:
-                    runner = runners[kind] = make_epoch_runner(step)
-                syn0, syn1, syn1neg, _loss = runner(
-                    syn0, syn1, syn1neg,
-                    self._pair_place(jnp.asarray(centers.reshape((T2, B)))),
-                    self._pair_place(jnp.asarray(contexts.reshape(
-                        (T2, B) + contexts.shape[1:]))),
-                    jnp.asarray(lrs, jnp.float32), keys)
+                    runner = runners[kind] = watch_compiles(
+                        make_epoch_runner(step), f"word2vec/{kind}_epoch")
+                with span("device/dispatch", kind=f"w2v_{kind}_epoch"):
+                    syn0, syn1, syn1neg, _loss = runner(
+                        syn0, syn1, syn1neg,
+                        self._pair_place(
+                            jnp.asarray(centers.reshape((T2, B)))),
+                        self._pair_place(jnp.asarray(contexts.reshape(
+                            (T2, B) + contexts.shape[1:]))),
+                        jnp.asarray(lrs, jnp.float32), keys)
                 done += T * B
         table.syn0 = syn0
         if table.use_hs:
@@ -338,11 +346,14 @@ class SequenceVectors(WordVectorsModel):
     def _fit_sg_corpus(self, seqs):
         """SGNS fast path: corpus on device, windows + negatives generated
         inside the scanned step (see make_skipgram_corpus_runner)."""
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         table = self.lookup_table
         runner_key = (id(table), self.window_size)
         if getattr(self, "_sg_runner_key", None) != runner_key:
-            self._sg_runner = make_skipgram_corpus_runner(
-                table, self.window_size)
+            self._sg_runner = watch_compiles(
+                make_skipgram_corpus_runner(table, self.window_size),
+                "word2vec/sgns_epoch")
             self._sg_runner_key = runner_key
         runner = self._sg_runner
         # fold the per-model fit count into the stream so INCREMENTAL fits
@@ -372,7 +383,9 @@ class SequenceVectors(WordVectorsModel):
                                                                  key):
             base_flat, base_sid = cache[1], cache[2]
         else:
-            base_flat, base_sid = self._flatten_corpus(seqs, subsample=False)
+            with span("host/flatten_corpus"):
+                base_flat, base_sid = self._flatten_corpus(seqs,
+                                                           subsample=False)
             self._sg_flat_cache = (key, base_flat, base_sid)
         if len(base_flat) < 2:
             return self
@@ -407,9 +420,10 @@ class SequenceVectors(WordVectorsModel):
                              self.learning_rate * (1.0 - frac))
             lrs[T:] = 0.0
             rng, k = jax.random.split(rng)
-            syn0, syn1neg, _loss = runner(
-                syn0, syn1neg, corpus_dev[0], corpus_dev[1],
-                pos_dev, jnp.asarray(lrs, jnp.float32), k)
+            with span("device/dispatch", kind="w2v_sgns_epoch"):
+                syn0, syn1neg, _loss = runner(
+                    syn0, syn1neg, corpus_dev[0], corpus_dev[1],
+                    pos_dev, jnp.asarray(lrs, jnp.float32), k)
         table.syn0 = syn0
         table.syn1neg = syn1neg
         return self

@@ -25,6 +25,8 @@ from .gradnorm import apply_gradient_normalization
 from .layers.feedforward import BaseOutputLayerConf
 from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
 from ..eval.evaluation import Evaluation
+from ..telemetry.compile_watch import watch_compiles
+from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 __all__ = ["ComputationGraph"]
 
@@ -384,7 +386,9 @@ class ComputationGraph:
 
     @functools.cached_property
     def _train_step(self):
-        return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
+        return watch_compiles(
+            jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2)),
+            "graph/train_step")
 
     @functools.cached_property
     def predict_fn(self):
@@ -399,7 +403,7 @@ class ComputationGraph:
 
     @functools.cached_property
     def _predict_fn(self):
-        return jax.jit(self.predict_fn)
+        return watch_compiles(jax.jit(self.predict_fn), "graph/predict")
 
     def _collect_outputs(self, params, state, values):
         """Activate the network outputs from forward values (shared by the
@@ -474,12 +478,16 @@ class ComputationGraph:
     def _fit_batch(self, ds):
         from .conf import OptimizationAlgorithm as OA
 
-        inputs, labels, fmasks, lmasks = self._to_inputs(ds)
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
+        with span("host/batch_prep"):
+            inputs, labels, fmasks, lmasks = self._to_inputs(ds)
         self._rng, step_rng = jax.random.split(self._rng)
         if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
-            self.params, self.state, score = self._line_solver.fit_batch(
-                self.params, self.state, inputs, labels, step_rng, fmasks,
-                lmasks)
+            with span("device/dispatch", kind="line_search"):
+                self.params, self.state, score = self._line_solver.fit_batch(
+                    self.params, self.state, inputs, labels, step_rng,
+                    fmasks, lmasks)
             self._score = score
             self.last_batch_size = int(
                 next(iter(inputs.values())).shape[0])
@@ -488,10 +496,14 @@ class ComputationGraph:
                 listener.iteration_done(self, self.iteration_count)
             return
         step = jnp.asarray(self.iteration_count, jnp.int32)
-        (self.params, self.state, self.updater_state,
-         score) = self._train_step(self.params, self.state,
-                                   self.updater_state, step, inputs, labels,
-                                   step_rng, fmasks, lmasks)
+        with span("device/dispatch", kind="train_step"):
+            (self.params, self.state, self.updater_state,
+             score) = self._train_step(self.params, self.state,
+                                       self.updater_state, step, inputs,
+                                       labels, step_rng, fmasks, lmasks)
+        if tel is not None and tel.sync_per_step:
+            with span("device/sync"):
+                jax.block_until_ready(score)
         self._score = score
         self.last_batch_size = int(next(iter(inputs.values())).shape[0])
         self.iteration_count += 1
@@ -518,12 +530,15 @@ class ComputationGraph:
             raise ValueError(
                 "fit_scan_arrays supports SGD-updater training only; "
                 "line-search optimizers are per-batch sequential — use fit()")
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         if not isinstance(xs, dict):
             xs = {self.conf.network_inputs[0]: xs}
         if not isinstance(ys, dict):
             ys = {self.conf.network_outputs[0]: ys}
-        xs = {k: jnp.asarray(v) for k, v in xs.items()}
-        ys = {k: jnp.asarray(v) for k, v in ys.items()}
+        with span("host/batch_prep"):
+            xs = {k: jnp.asarray(v) for k, v in xs.items()}
+            ys = {k: jnp.asarray(v) for k, v in ys.items()}
         key = (tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in xs.items())),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
@@ -549,19 +564,23 @@ class ComputationGraph:
                     body, (params, state, opt, step0), (xs, ys, keys))
                 return params, state, opt, scores
 
-            cache[key] = epoch_fn
+            epoch_fn = cache[key] = watch_compiles(epoch_fn,
+                                                   "graph/scan_epoch")
         n_steps = int(next(iter(xs.values())).shape[0])
         if self.listeners:
             from ..optimize.listeners import warn_scan_replay
             warn_scan_replay(self.listeners)
         for _ in range(epochs):
             self._rng, k = jax.random.split(self._rng)
-            (self.params, self.state, self.updater_state, scores) = epoch_fn(
-                self.params, self.state, self.updater_state,
-                jnp.asarray(self.iteration_count, jnp.int32), xs, ys, k)
+            with span("device/dispatch", kind="scan_epoch"):
+                (self.params, self.state, self.updater_state,
+                 scores) = epoch_fn(
+                    self.params, self.state, self.updater_state,
+                    jnp.asarray(self.iteration_count, jnp.int32), xs, ys, k)
             self.last_batch_size = int(next(iter(xs.values())).shape[1])
             if self.listeners:
-                host_scores = np.asarray(scores)
+                with span("device/sync", kind="scan_scores"):
+                    host_scores = np.asarray(scores)
                 for i in range(n_steps):
                     self._score = host_scores[i]
                     self.iteration_count += 1

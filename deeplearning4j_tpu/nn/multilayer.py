@@ -36,6 +36,8 @@ from .gradnorm import apply_gradient_normalization
 from .layers.feedforward import BaseOutputLayerConf
 from ..datasets.iterators import ArrayDataSetIterator, DataSet, DataSetIterator
 from ..eval.evaluation import Evaluation
+from ..telemetry.compile_watch import watch_compiles
+from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -340,7 +342,9 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _train_step(self):
-        return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
+        return watch_compiles(
+            jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2)),
+            "nn/train_step")
 
     @functools.cached_property
     def predict_fn(self):
@@ -354,11 +358,13 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _predict_fn(self):
-        return jax.jit(self.predict_fn)
+        return watch_compiles(jax.jit(self.predict_fn), "nn/predict")
 
     @functools.cached_property
     def _tbptt_step(self):
-        return jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2))
+        return watch_compiles(
+            jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2)),
+            "nn/tbptt_step")
 
     @functools.cached_property
     def _rnn_step_fn(self):
@@ -499,10 +505,13 @@ class MultiLayerNetwork:
                 "fit_scan_arrays supports SGD-updater training only; "
                 "line-search optimizers (CG/LBFGS) are per-batch sequential "
                 "— use fit()")
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         tbptt = (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                  and xs.ndim >= 4)
         firsts = None
-        xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+        with span("host/batch_prep"):
+            xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
         fm_d = jnp.asarray(fmask) if fmask is not None else None
         lm_d = jnp.asarray(lmask) if lmask is not None else None
         if tbptt:
@@ -538,8 +547,9 @@ class MultiLayerNetwork:
         cache = self.__dict__.setdefault("_scan_epoch_cache", {})
         epoch_fn = cache.get(key)
         if epoch_fn is None:
-            epoch_fn = cache[key] = self._make_scan_epoch(
-                fm_d is not None, lm_d is not None, tbptt)
+            epoch_fn = cache[key] = watch_compiles(
+                self._make_scan_epoch(fm_d is not None, lm_d is not None,
+                                      tbptt), "nn/scan_epoch")
         fs_d = jnp.asarray(firsts) if tbptt else None
         if self.listeners:
             from ..optimize.listeners import warn_scan_replay
@@ -549,17 +559,19 @@ class MultiLayerNetwork:
                 if hasattr(listener, "on_epoch_start"):
                     listener.on_epoch_start(self)
             self._rng, k = jax.random.split(self._rng)
-            (self.params, self.state, self.updater_state,
-             scores) = epoch_fn(
-                self.params, self.state, self.updater_state,
-                jnp.asarray(self.iteration_count, jnp.int32),
-                xs_d, ys_d, fm_d, lm_d, fs_d,
-                carries0 if tbptt else (), k)
+            with span("device/dispatch", kind="scan_epoch"):
+                (self.params, self.state, self.updater_state,
+                 scores) = epoch_fn(
+                    self.params, self.state, self.updater_state,
+                    jnp.asarray(self.iteration_count, jnp.int32),
+                    xs_d, ys_d, fm_d, lm_d, fs_d,
+                    carries0 if tbptt else (), k)
             self.last_batch_size = int(xs_d.shape[1])
             self.last_input = xs_d[-1]   # last scanned batch, for listeners
             n_steps = int(xs_d.shape[0])
             if self.listeners:
-                host_scores = np.asarray(scores)
+                with span("device/sync", kind="scan_scores"):
+                    host_scores = np.asarray(scores)
                 for i in range(n_steps):
                     self._score = host_scores[i]
                     self.iteration_count += 1
@@ -681,8 +693,11 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet):
         from .conf import OptimizationAlgorithm as OA
 
-        x, y, fmask, lmask = ds.device_tuple()
-        self._check_input_width(x)
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
+        with span("host/batch_prep"):
+            x, y, fmask, lmask = ds.device_tuple()
+            self._check_input_width(x)
         self.last_input = x   # reference setInput keeps the batch around;
         # listeners (e.g. ConvolutionalIterationListener) read it
         if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
@@ -695,14 +710,19 @@ class MultiLayerNetwork:
         if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
             # line-search path (Solver.java -> CG/LBFGS/line GD); the
             # updater chain is SGD-only, as in the reference's BaseOptimizer
-            self.params, self.state, score = self._line_solver.fit_batch(
-                self.params, self.state, x, y, step_rng, fmask, lmask)
+            with span("device/dispatch", kind="line_search"):
+                self.params, self.state, score = self._line_solver.fit_batch(
+                    self.params, self.state, x, y, step_rng, fmask, lmask)
         else:
             step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
-            (self.params, self.state, self.updater_state,
-             score) = self._train_step(
-                self.params, self.state, self.updater_state, step, x, y,
-                step_rng, fmask, lmask)
+            with span("device/dispatch", kind="train_step"):
+                (self.params, self.state, self.updater_state,
+                 score) = self._train_step(
+                    self.params, self.state, self.updater_state, step, x, y,
+                    step_rng, fmask, lmask)
+        if tel is not None and tel.sync_per_step:
+            with span("device/sync"):
+                jax.block_until_ready(score)
         self._score = score
         self.last_batch_size = int(x.shape[0])
         self.iteration_count += 1
@@ -719,6 +739,8 @@ class MultiLayerNetwork:
         """Truncated BPTT (reference `doTruncatedBPTT`,
         `MultiLayerNetwork.java:1119`): split the series into fwd-length
         chunks; hidden state flows forward between chunks, gradients do not."""
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
         carries = self._zero_carries(int(x.shape[0]), x.dtype)
@@ -733,12 +755,16 @@ class MultiLayerNetwork:
                 chunk(x), chunk(y), chunk(fmask), chunk(lmask))
             self._rng, step_rng = jax.random.split(self._rng)
             step = jnp.asarray(self.iteration_count, dtype=jnp.int32)
-            (self.params, self.state, self.updater_state, score,
-             carries) = self._tbptt_step(
-                self.params, self.state, self.updater_state, step,
-                x[:, sl], y[:, sl], step_rng,
-                None if fmask is None else fmask[:, sl],
-                None if lmask is None else lmask[:, sl], carries)
+            with span("device/dispatch", kind="tbptt_chunk"):
+                (self.params, self.state, self.updater_state, score,
+                 carries) = self._tbptt_step(
+                    self.params, self.state, self.updater_state, step,
+                    x[:, sl], y[:, sl], step_rng,
+                    None if fmask is None else fmask[:, sl],
+                    None if lmask is None else lmask[:, sl], carries)
+            if tel is not None and tel.sync_per_step:
+                with span("device/sync"):
+                    jax.block_until_ready(score)
             self._score = score
             self.last_batch_size = int(x.shape[0])
             self.iteration_count += 1

@@ -76,9 +76,13 @@ class PerformanceListener(IterationListener):
         self.frequency = max(1, int(frequency))
         self.report_score = report_score
         self.printer = printer or (lambda s: log.info(s))
-        self._last_time = None
+        # the window opens when the listener is attached: the first batch
+        # (which pays XLA compilation) is COUNTED, not silently discarded,
+        # and its record carries warmup=True so dashboards can exclude it
+        self._last_time = time.perf_counter()
         self._samples = 0
         self._batches = 0
+        self._first_window = True
         self.history: List[dict] = []
 
     def iteration_done(self, model, iteration: int):
@@ -86,24 +90,26 @@ class PerformanceListener(IterationListener):
         batch = getattr(model, "last_batch_size", 0)
         self._samples += batch
         self._batches += 1
-        if self._last_time is None:
-            self._last_time = now
-            self._samples = 0
-            self._batches = 0
-            return
         if self._batches >= self.frequency:
-            dt = now - self._last_time
+            # clamp: back-to-back replayed iterations (fit_scan listener
+            # replay) can land in the same perf_counter tick — a rate from
+            # a clamped dt is inflated but finite, never NaN
+            dt = max(now - self._last_time, 1e-9)
             rec = {
                 "iteration": iteration,
-                "samples_per_sec": self._samples / dt if dt > 0 else float("nan"),
-                "batches_per_sec": self._batches / dt if dt > 0 else float("nan"),
+                "samples_per_sec": self._samples / dt,
+                "batches_per_sec": self._batches / dt,
             }
+            if self._first_window:
+                rec["warmup"] = True
+                self._first_window = False
             if self.report_score:
                 rec["score"] = float(model.score())
             self.history.append(rec)
             self.printer(
                 f"iteration {iteration}: {rec['samples_per_sec']:.1f} samples/sec, "
-                f"{rec['batches_per_sec']:.2f} batches/sec")
+                f"{rec['batches_per_sec']:.2f} batches/sec"
+                + (" (warmup window)" if rec.get("warmup") else ""))
             self._last_time = now
             self._samples = 0
             self._batches = 0
@@ -121,10 +127,28 @@ class CollectScoresIterationListener(IterationListener):
             self.scores.append((iteration, float(model.score())))
 
     def export_scores(self, path, delimiter=","):
-        with open(path, "w") as f:
+        # explicit encoding + newline: without them Windows writes CRLF and
+        # the platform codec garbles non-ASCII paths/headers on re-import
+        with open(path, "w", encoding="utf-8", newline="\n") as f:
             f.write(f"iteration{delimiter}score\n")
             for it, s in self.scores:
                 f.write(f"{it}{delimiter}{s}\n")
+
+    @staticmethod
+    def load_scores(path, delimiter=",") -> List[tuple]:
+        """Round-trip reader for `export_scores` output."""
+        out: List[tuple] = []
+        with open(path, "r", encoding="utf-8", newline="") as f:
+            header = f.readline()
+            if not header.startswith("iteration"):
+                raise ValueError(f"not an export_scores file: {path}")
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                it, s = line.split(delimiter, 1)
+                out.append((int(it), float(s)))
+        return out
 
 
 class ParamAndGradientIterationListener(IterationListener):

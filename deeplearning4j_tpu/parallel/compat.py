@@ -1,0 +1,28 @@
+"""jax version compatibility for the parallel stack.
+
+`shard_map` graduated from `jax.experimental.shard_map` (where its
+replication-check kwarg is `check_rep`) to `jax.shard_map` (where it is
+`check_vma`). The trainers target the new spelling; this shim keeps them
+runnable on the experimental API so a jax upgrade/downgrade never lands as
+an ImportError deep inside `ParallelTrainer._prepare`.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map as _shard_map
+    _LEGACY = False
+except ImportError:  # pre-graduation jax: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              **kw):
+    if _LEGACY:
+        kw.setdefault("check_rep", check_vma)
+    else:
+        kw["check_vma"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

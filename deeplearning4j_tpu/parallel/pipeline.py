@@ -107,7 +107,7 @@ class PipelinedDenseStack:
 
     def pipelined_forward(self, params, x, n_microbatches: Optional[int] = None):
         """x: [B, F] -> [B, F] through the pipeline."""
-        from jax import shard_map
+        from .compat import shard_map
 
         M = n_microbatches or self.n_stages
         B = x.shape[0]

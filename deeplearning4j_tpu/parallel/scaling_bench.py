@@ -177,7 +177,7 @@ def measure_pipeline(s_stages: int = 4, microbatches=(1, 2, 4, 8),
     # a fixed cost that would masquerade as bubble at small M
     import functools as _ft
 
-    from jax import shard_map as _shard_map
+    from .compat import shard_map as _shard_map
     from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
 
     from .pipeline import pipeline_forward as _pf
@@ -268,6 +268,19 @@ def measure_pipeline(s_stages: int = 4, microbatches=(1, 2, 4, 8),
     return out
 
 
+def _telemetry_fields(sess):
+    """Compile-count + host/device time attribution for the multichip JSON
+    (one line artifact: a regressed efficiency number is diagnosable as
+    compile churn vs collective overhead without re-running)."""
+    spans = sess.span_totals()
+    return {"xla_compilations": sess.compiles.total(),
+            "compiles": {k: v["count"]
+                         for k, v in sess.compiles.report().items()},
+            "dispatch_seconds": round(spans.get("device/dispatch", 0.0), 4),
+            "sync_seconds": round(spans.get("device/sync", 0.0), 4),
+            "peak_rss_mb": round(sess.watermarks.peak_rss_mb(), 1)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
@@ -280,10 +293,15 @@ def main(argv=None):
     ap.add_argument("--mode", choices=("dp", "pipeline"), default="dp")
     a = ap.parse_args(argv)
     _provision(a.devices)
+    from ..telemetry import runtime as telemetry_runtime
+    sess = telemetry_runtime.enable()
     if a.mode == "pipeline":
-        print(json.dumps(measure_pipeline(
+        out = measure_pipeline(
             s_stages=min(4, a.devices), global_batch=a.global_batch,
-            steps=a.steps, reps=max(3, a.reps))))
+            steps=a.steps, reps=max(3, a.reps))
+        sess.watermarks.sample()
+        out["telemetry"] = _telemetry_fields(sess)
+        print(json.dumps(out))
         return
     m1 = measure(1, a.global_batch, a.steps, model=a.model,
                  image=a.image, reps=a.reps)
@@ -319,6 +337,8 @@ def main(argv=None):
             "phases_1dev_sgd_ms": m1s["phases_ms"],
             "phases_ndev_sgd_ms": mns["phases_ms"],
             "replicated_updater_cost_ms": round((tn - tns) - (t1 - t1s), 2)}
+    sess.watermarks.sample()
+    out["telemetry"] = _telemetry_fields(sess)
     print(json.dumps(out))
 
 

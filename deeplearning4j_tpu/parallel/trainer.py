@@ -38,6 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import MeshAxes, make_mesh
 from .sharding import ShardingStrategy, param_specs
 from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
+from ..telemetry.compile_watch import watch_compiles
+from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 __all__ = ["ParallelTrainer", "ParallelWrapper", "TrainingMode"]
 
@@ -142,12 +144,12 @@ class ParallelTrainer:
             self._params = jax.device_put(m.params, p_sh)
             self._state = jax.device_put(m.state, repl)
             self._opt = jax.device_put(m.updater_state, o_sh)
-            self._step_fn = jax.jit(
+            self._step_fn = watch_compiles(jax.jit(
                 m.train_step_fn,
                 in_shardings=(p_sh, repl, o_sh, repl, batch_sh, batch_sh,
                               repl, batch_sh, batch_sh),
                 out_shardings=(p_sh, repl, o_sh, repl),
-                donate_argnums=(0, 1, 2))
+                donate_argnums=(0, 1, 2)), "parallel/train_step")
         else:
             # AVERAGING: per-device replicas — stack params on a leading
             # device axis sharded over data
@@ -164,7 +166,7 @@ class ParallelTrainer:
             self._opt = jax.device_put(
                 jax.tree_util.tree_map(stack, m.updater_state), stack_sh)
 
-            from jax import shard_map
+            from .compat import shard_map
             axis = self.data_axis
 
             def local_step(params, state, opt, step, x, y, fm, lm, rng):
@@ -182,12 +184,13 @@ class ParallelTrainer:
                 return uq(p), uq(s), uq(o), score[None]
 
             spec = P(axis)
-            self._local_step = jax.jit(shard_map(
+            self._local_step = watch_compiles(jax.jit(shard_map(
                 local_step, mesh=mesh,
                 in_specs=(spec, spec, spec, P(), spec, spec, spec, spec,
                           P()),
                 out_specs=(spec, spec, spec, spec),
-                check_vma=False), donate_argnums=(0, 1, 2))
+                check_vma=False), donate_argnums=(0, 1, 2)),
+                "parallel/local_step")
 
             def average(params, opt):
                 pa = jax.tree_util.tree_map(
@@ -201,11 +204,11 @@ class ParallelTrainer:
                     oa = opt
                 return pa, oa
 
-            self._average = jax.jit(
+            self._average = watch_compiles(jax.jit(
                 average,
                 in_shardings=(stack_sh, stack_sh),
                 out_shardings=(stack_sh, stack_sh),
-                donate_argnums=(0, 1))
+                donate_argnums=(0, 1)), "parallel/average")
 
         self.iteration_count = 0
         self._score = float("nan")
@@ -258,9 +261,11 @@ class ParallelTrainer:
         import contextlib
 
         tmap = jax.tree_util.tree_map
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
         phase = (self.stats.time if self.stats is not None
                  else (lambda key: contextlib.nullcontext()))
-        with phase("data"):
+        with phase("data"), span("host/batch_prep"):
             local_shard = bool(getattr(ds, "is_local_shard", False))
             xd, yd, fm, lm = self._to_batch(ds)
             n = self.n_data
@@ -295,32 +300,42 @@ class ParallelTrainer:
         step = jnp.asarray(self.iteration_count, jnp.int32)
         if self.mode == TrainingMode.SYNC:
             with phase("step"):
-                self._params, self._state, self._opt, score = self._step_fn(
-                    self._params, self._state, self._opt, step,
-                    xd, yd, rng, fm, lm)
+                with span("device/dispatch", kind="sync_step"):
+                    (self._params, self._state, self._opt,
+                     score) = self._step_fn(
+                        self._params, self._state, self._opt, step,
+                        xd, yd, rng, fm, lm)
                 self._score = score
-                if self.stats is not None:
-                    float(jnp.asarray(score))  # sync for honest timing
+                if self.stats is not None or (tel is not None
+                                              and tel.sync_per_step):
+                    with span("device/sync"):
+                        float(jnp.asarray(score))  # sync for honest timing
         else:
             with phase("step"):
                 resh = lambda t: tmap(
                     lambda a: a.reshape(n, -1, *a.shape[1:]), t)
                 xs, ys, fms, lms = resh(xd), resh(yd), resh(fm), resh(lm)
-                (self._params, self._state, self._opt,
-                 scores) = self._local_step(
-                    self._params, self._state, self._opt, step, xs, ys,
-                    fms, lms, rng)
+                with span("device/dispatch", kind="local_step"):
+                    (self._params, self._state, self._opt,
+                     scores) = self._local_step(
+                        self._params, self._state, self._opt, step, xs, ys,
+                        fms, lms, rng)
                 self._score = scores.mean()
-                if self.stats is not None:
-                    float(jnp.asarray(self._score))
+                if self.stats is not None or (tel is not None
+                                              and tel.sync_per_step):
+                    with span("device/sync"):
+                        float(jnp.asarray(self._score))
             if (self.iteration_count + 1) % self.averaging_frequency == 0:
-                with phase("average"):
+                with phase("average"), span("device/average"):
                     self._params, self._opt = self._average(self._params,
                                                             self._opt)
                     if self.stats is not None:
                         jax.block_until_ready(
                             jax.tree_util.tree_leaves(self._params)[0])
         self.iteration_count += 1
+        if tel is not None and self.iteration_count % tel.report_window == 0:
+            # per-device watermarks over THIS trainer's mesh
+            tel.watermarks.sample(devices=list(self.mesh.devices.flat))
 
     def score(self, ds=None) -> float:
         """No-arg: last minibatch training score (reference ParallelWrapper

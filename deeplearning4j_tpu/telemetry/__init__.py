@@ -1,0 +1,39 @@
+"""Runtime observability: metrics registry, step tracing, XLA compile
+watching, resource watermarks.
+
+The model/listener layer (`optimize/listeners.py`, `ui/stats.py`) answers
+"is the MODEL learning"; this package answers "is the RUNTIME healthy" —
+XLA compilation churn, host-vs-device time split, dispatch stalls, memory
+watermarks. Dapper-style always-on tracing (Sigelman et al., 2010) applied
+to the jitted training loop: a disabled session costs one global read per
+step, an enabled one a few microseconds per span.
+
+Four pieces:
+  * `MetricsRegistry` (registry.py) — thread-safe counters / gauges /
+    histograms / timers with Prometheus-text and JSONL exporters.
+  * `Tracer` (tracing.py) — spans in Chrome trace-event JSON, loadable in
+    Perfetto / chrome://tracing.
+  * `CompileWatcher` (compile_watch.py) — counts XLA compilations per
+    jitted entry point and warns on recompilation storms from shape churn
+    (the silent TPU killer).
+  * `ResourceWatermarks` (resources.py) — host RSS + live device buffer
+    bytes, current and peak.
+
+`TelemetrySession` (runtime.py) bundles them; `telemetry.enable()` installs
+the process-wide session the instrumented hot paths consult.
+`TelemetryListener` (listener.py) wires per-iteration metrics into the
+existing listener chain without touching StatsListener/UI.
+"""
+from .compile_watch import CompileWatcher, watch_compiles
+from .listener import TelemetryListener
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, Timer)
+from .resources import ResourceWatermarks
+from .runtime import TelemetrySession, active, disable, enable, enabled
+from .tracing import Tracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
+    "Tracer", "CompileWatcher", "watch_compiles", "ResourceWatermarks",
+    "TelemetrySession", "TelemetryListener",
+    "active", "enable", "disable", "enabled",
+]

@@ -1,0 +1,90 @@
+"""Resource watermarks: host RSS + live device buffer bytes, current and
+peak, sampled per report window (cheap enough for always-on use —
+/proc reads and `memory_stats()` are microseconds, no device sync).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+try:
+    import resource as _resource
+except ImportError:  # Windows has no stdlib resource module
+    _resource = None
+
+__all__ = ["ResourceWatermarks", "host_rss_mb", "host_peak_rss_mb"]
+
+# ru_maxrss units differ: Linux reports KiB, macOS reports bytes
+_MAXRSS_TO_MB = (1024.0 * 1024.0) if sys.platform == "darwin" else 1024.0
+
+
+def host_peak_rss_mb() -> float:
+    """Peak RSS of this process (0.0 where getrusage is unavailable)."""
+    if _resource is None:
+        return 0.0
+    return (_resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+            / _MAXRSS_TO_MB)
+
+
+def host_rss_mb() -> float:
+    """Current RSS; falls back to the peak where /proc is unavailable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0)
+    except Exception:
+        return host_peak_rss_mb()
+
+
+def _device_bytes(dev) -> Optional[int]:
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return int(stats.get("bytes_in_use", 0))
+
+
+class ResourceWatermarks:
+    def __init__(self, registry):
+        self._rss = registry.gauge(
+            "dl4j_host_rss_mb", "host resident set size (MiB)")
+        self._rss_peak = registry.gauge(
+            "dl4j_host_rss_peak_mb", "peak host RSS (MiB)")
+        self._dev = registry.gauge(
+            "dl4j_device_bytes_in_use", "live device buffer bytes",
+            labels=("device",))
+        self._dev_peak = registry.gauge(
+            "dl4j_device_bytes_peak", "peak live device buffer bytes",
+            labels=("device",))
+
+    def sample(self, devices=None) -> Dict:
+        """Update the gauges (and peaks) and return the sample. `devices`
+        defaults to the local jax devices; CPU backends without
+        `memory_stats` simply contribute no device series."""
+        rss = host_rss_mb()
+        peak = host_peak_rss_mb()
+        self._rss.set(rss)
+        self._rss_peak.set_max(max(peak, rss))
+        out = {"host_rss_mb": round(rss, 2),
+               "host_rss_peak_mb": round(max(peak, rss), 2)}
+        if devices is None:
+            try:
+                import jax
+                devices = jax.local_devices()
+            except Exception:
+                devices = ()
+        for dev in devices:
+            b = _device_bytes(dev)
+            if b is None:
+                continue
+            key = str(getattr(dev, "id", dev))
+            self._dev.set(b, device=key)
+            self._dev_peak.set_max(b, device=key)
+            out[f"device{key}_bytes"] = b
+        return out
+
+    def peak_rss_mb(self) -> float:
+        return self._rss_peak.value()

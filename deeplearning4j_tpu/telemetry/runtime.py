@@ -1,0 +1,178 @@
+"""Process-wide telemetry session + the hot-path hooks the models consult.
+
+The instrumented paths (`MultiLayerNetwork._fit_batch`,
+`ComputationGraph._fit_batch`, `fit_scan_arrays`, `ParallelTrainer`,
+`Word2Vec.fit`) each do:
+
+    tel = runtime.active()
+    span = tel.span if tel is not None else runtime.null_span
+    with span("host/batch_prep"): ...
+
+so a disabled session costs one module-global read and a shared no-op
+context manager per step — cheap enough to leave compiled in everywhere.
+
+`TelemetrySession.span` records BOTH a Chrome trace event and an
+aggregate `dl4j_span_seconds{span=...}` histogram observation: the trace
+answers "what happened around step 4017", the registry answers "where did
+the epoch's wall time go" even after the trace buffer wraps.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from .compile_watch import CompileWatcher
+from .registry import MetricsRegistry
+from .resources import ResourceWatermarks
+from .tracing import Tracer
+
+__all__ = ["TelemetrySession", "active", "enable", "disable", "enabled",
+           "null_span"]
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+def null_span(name=None, **args) -> _NullCtx:
+    """Shared no-op span (telemetry disabled)."""
+    return _NULL
+
+
+class _TimedSpan:
+    __slots__ = ("_sess", "_name", "_args", "_t0")
+
+    def __init__(self, sess, name, args):
+        self._sess = sess
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        s = self._sess
+        s.tracer._complete(self._name, self._t0, t1, self._args)
+        s.span_seconds.observe(t1 - self._t0, span=self._name)
+        return False
+
+
+class TelemetrySession:
+    """Bundles the four telemetry pieces behind one object.
+
+    sync_per_step: when True the instrumented dispatch paths insert a
+    device sync after each step so the "device/sync" span honestly
+    attributes device time per iteration (one extra host sync per step —
+    same opt-in cost as ParallelTrainer's collect_stats). When False
+    (default) dispatch stays fully async and device time accumulates in
+    whichever call naturally blocks (scan-epoch score materialization,
+    listener score reads).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 sync_per_step: bool = False,
+                 storm_threshold: int = 3,
+                 report_window: int = 10):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.compiles = CompileWatcher(self.registry, self.tracer,
+                                       storm_threshold=storm_threshold)
+        self.watermarks = ResourceWatermarks(self.registry)
+        self.sync_per_step = bool(sync_per_step)
+        self.report_window = max(1, int(report_window))
+        self.span_seconds = self.registry.timer(
+            "dl4j_span_seconds", "wall seconds per runtime span",
+            labels=("span",))
+
+    def span(self, name: str, **args) -> _TimedSpan:
+        return _TimedSpan(self, name, args or None)
+
+    # -- artifacts ------------------------------------------------------
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
+    def export_prometheus(self, path) -> str:
+        with open(path, "w", encoding="utf-8", newline="\n") as f:
+            f.write(self.registry.prometheus_text())
+        return str(path)
+
+    def export_chrome_trace(self, path) -> str:
+        return self.tracer.export_chrome_trace(path)
+
+    def export_jsonl(self, path, extra=None):
+        self.registry.export_jsonl(path, extra=extra)
+
+    def span_totals(self) -> Dict[str, float]:
+        """{span name: total wall seconds} from the aggregate histogram."""
+        return {k[0]: v for k, v in self.span_seconds.sums().items()}
+
+    def summary(self) -> Dict:
+        """The compact dict bench.py embeds as extras.telemetry."""
+        rep = self.compiles.report()
+        self.watermarks.sample()
+        return {
+            "xla_compilations": self.compiles.total(),
+            "compiles": {k: v["count"] for k, v in rep.items()},
+            "compile_wall_s": round(sum(v["wall_s"] for v in rep.values()),
+                                    3),
+            "span_seconds": {k: round(v, 4)
+                             for k, v in sorted(self.span_totals().items())},
+            "peak_rss_mb": round(self.watermarks.peak_rss_mb(), 1),
+            "trace_events": len(self.tracer),
+        }
+
+
+_active: Optional[TelemetrySession] = None
+
+
+def active() -> Optional[TelemetrySession]:
+    return _active
+
+
+def enable(session: Optional[TelemetrySession] = None, **kw
+           ) -> TelemetrySession:
+    """Install `session` (or a new one built from **kw) as the process-wide
+    session. With no arguments and a session already active, this is
+    idempotent and returns the active session."""
+    global _active
+    if session is None:
+        if _active is not None and not kw:
+            return _active
+        session = TelemetrySession(**kw)
+    _active = session
+    return session
+
+
+def disable() -> Optional[TelemetrySession]:
+    """Deactivate and return the previous session (its artifacts remain
+    exportable)."""
+    global _active
+    prev = _active
+    _active = None
+    return prev
+
+
+@contextlib.contextmanager
+def enabled(session: Optional[TelemetrySession] = None, **kw):
+    """Scoped activation; restores the previous session on exit."""
+    global _active
+    prev = _active
+    sess = session if session is not None else TelemetrySession(**kw)
+    _active = sess
+    try:
+        yield sess
+    finally:
+        _active = prev

@@ -1,0 +1,103 @@
+"""Step tracing in Chrome trace-event JSON (Perfetto / chrome://tracing).
+
+Complete ("X") events with microsecond timestamps relative to tracer
+creation. The buffer is bounded: when full, new events are dropped and
+counted (`dropped_events`) instead of growing without limit — always-on
+tracing must not become the memory leak it exists to catch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Tracer"]
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._complete(self._name, self._t0, time.perf_counter(),
+                               self._args)
+        return False
+
+
+class Tracer:
+    def __init__(self, max_events: int = 200_000,
+                 process_name: str = "deeplearning4j_tpu"):
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._max_events = int(max_events)
+        self.dropped_events = 0
+        self._pid = os.getpid()
+        self._append({"ph": "M", "name": "process_name", "pid": self._pid,
+                      "tid": 0, "args": {"name": process_name}})
+
+    def _append(self, ev: Dict):
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self.dropped_events += 1
+                return
+            self._events.append(ev)
+
+    def _us(self, t: float) -> float:
+        return (t - self._t0) * 1e6
+
+    def _complete(self, name, t_start, t_end, args):
+        ev = {"ph": "X", "name": name, "cat": "runtime",
+              "ts": round(self._us(t_start), 3),
+              "dur": round((t_end - t_start) * 1e6, 3),
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager recording a complete event around the block."""
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args):
+        ev = {"ph": "i", "name": name, "cat": "runtime", "s": "t",
+              "ts": round(self._us(time.perf_counter()), 3),
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, **series):
+        """Chrome counter-track event (rendered as a stacked area chart)."""
+        self._append({"ph": "C", "name": name, "cat": "runtime",
+                      "ts": round(self._us(time.perf_counter()), 3),
+                      "pid": self._pid, "tid": 0, "args": series})
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> Dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped_events}}
+
+    def export_chrome_trace(self, path) -> str:
+        """Write the trace JSON; open the file in Perfetto
+        (https://ui.perfetto.dev) or chrome://tracing."""
+        with open(path, "w", encoding="utf-8", newline="\n") as f:
+            json.dump(self.chrome_trace(), f)
+        return str(path)

@@ -3,8 +3,14 @@
 # 8-device virtual CPU mesh, optionally in a loop to shake out flakes.
 #   ./runtests.sh            one pass
 #   ./runtests.sh 5          five consecutive passes (stop on first failure)
+#   ./runtests.sh telemetry  telemetry smoke only (registry/tracing/compile
+#                            watcher; tmp_path-only file writes, no network)
 set -euo pipefail
 cd "$(dirname "$0")"
+if [[ "${1:-}" == "telemetry" ]]; then
+    echo "=== telemetry smoke ==="
+    exec python -m pytest tests/test_telemetry.py -q
+fi
 runs="${1:-1}"
 for i in $(seq 1 "$runs"); do
     echo "=== test pass $i/$runs ==="
