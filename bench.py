@@ -165,6 +165,15 @@ def main():
     extras["charRNN-tokens-dispatch"] = round(rnn_d, 1)
     extras["charRNN-tokens-dispatch-spread"] = sp
     try:
+        # input-pipeline before/after (ISSUE 3): ragged-final-batch LeNet —
+        # serial (2 train-step compiles) vs pad_ragged (1 compile,
+        # pad_fraction) vs pad_ragged+prefetch (H2D overlapped); each
+        # variant under its own telemetry session
+        from deeplearning4j_tpu.models.zoo import bench_lenet_ragged
+        extras["LeNet-ragged-pipeline"] = bench_lenet_ragged()
+    except Exception as e:
+        extras["LeNet-ragged-pipeline"] = f"error: {type(e).__name__}"
+    try:
         w2v_cold, warms, w2v_tel = bench_word2vec()
         extras["Word2Vec-SGNS-words"] = round(w2v_cold, 1)
         warms = sorted(warms)

@@ -42,7 +42,8 @@ from .nn.conf.graph import (ComputationGraphConfiguration,
 from .nn.transferlearning import (FineTuneConfiguration, TransferLearning,
                                   TransferLearningHelper)
 from .datasets import (ArrayDataSetIterator, DataSet, DataSetIterator,
-                       MultiDataSet)
+                       DevicePrefetchIterator, MultiDataSet,
+                       PadToBatchIterator)
 from .eval import (Evaluation, ROC, ROCMultiClass, RegressionEvaluation)
 from .util import GradientCheckUtil, ModelSerializer
 from . import telemetry
@@ -70,7 +71,8 @@ __all__ = [
     "PreprocessorVertex", "ScaleVertex", "ShiftVertex", "StackVertex",
     "SubsetVertex", "UnstackVertex",
     "FineTuneConfiguration", "TransferLearning", "TransferLearningHelper",
-    "ArrayDataSetIterator", "DataSet", "DataSetIterator", "MultiDataSet",
+    "ArrayDataSetIterator", "DataSet", "DataSetIterator",
+    "DevicePrefetchIterator", "MultiDataSet", "PadToBatchIterator",
     "Evaluation", "ROC", "ROCMultiClass", "RegressionEvaluation",
     "GradientCheckUtil", "ModelSerializer",
     "telemetry", "TelemetryListener", "TelemetrySession",

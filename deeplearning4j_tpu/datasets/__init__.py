@@ -3,6 +3,9 @@ from .iterators import (
     ArrayDataSetIterator, AsyncDataSetIterator, MultipleEpochsIterator,
     SamplingDataSetIterator, IteratorDataSetIterator, ExistingDataSetIterator,
 )
+from .pipeline import (
+    PadToBatchIterator, DevicePrefetchIterator, pad_dataset, build_pipeline,
+)
 from .export import (
     export_datasets, export_sharded, load_dataset, PathDataSetIterator,
     ShardedPathDataSetIterator, LocalShardDataSet,
@@ -16,6 +19,8 @@ __all__ = [
     "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "IteratorDataSetIterator",
     "ExistingDataSetIterator",
+    "PadToBatchIterator", "DevicePrefetchIterator", "pad_dataset",
+    "build_pipeline",
     "export_datasets", "export_sharded", "load_dataset",
     "PathDataSetIterator", "ShardedPathDataSetIterator", "LocalShardDataSet",
     "LabeledPoint", "LabeledPointDataSetIterator",

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -193,10 +194,28 @@ class ArrayDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        if drop_last and self.features.shape[0] < self.batch_size:
+            # has_next() would be False forever: every epoch yields ZERO
+            # batches and fit() silently trains on nothing
+            warnings.warn(
+                f"ArrayDataSetIterator(drop_last=True) with only "
+                f"{self.features.shape[0]} examples < batch_size="
+                f"{self.batch_size}: every epoch yields zero batches, so "
+                "fit() will train on NOTHING. Lower batch_size, set "
+                "drop_last=False, or pad with "
+                "datasets.pipeline.PadToBatchIterator",
+                UserWarning, stacklevel=2)
         self._epoch = 0
+        self._drawn = False   # batches consumed since the last reset?
         self.reset()
 
     def reset(self):
+        # Epoch E shuffles with `seed + E`, E counting CONSUMED epochs:
+        # reset() only advances the epoch after a batch was drawn, so the
+        # constructor's reset and fit()'s epoch-start reset both leave the
+        # first epoch on `seed + 0` (reproducible from `seed=` alone).
+        if self._drawn:
+            self._epoch += 1
         n = self.features.shape[0]
         if self.shuffle:
             rng = np.random.default_rng(
@@ -205,7 +224,7 @@ class ArrayDataSetIterator(DataSetIterator):
         else:
             self._order = np.arange(n)
         self._pos = 0
-        self._epoch += 1
+        self._drawn = False
 
     def has_next(self) -> bool:
         remaining = len(self._order) - self._pos
@@ -216,6 +235,7 @@ class ArrayDataSetIterator(DataSetIterator):
     def next(self) -> DataSet:
         idx = self._order[self._pos:self._pos + self.batch_size]
         self._pos += len(idx)
+        self._drawn = True
 
         def take(a):
             return None if a is None else a[idx]
@@ -399,7 +419,17 @@ class SamplingDataSetIterator(DataSetIterator):
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (double buffering) — parity with
     `datasets/iterator/AsyncDataSetIterator.java:33`, including worker-exception
-    propagation to the caller."""
+    propagation to the caller.
+
+    Consumer protocol: queue entries are `(batch, more)` pairs, `more`
+    evaluated by the WORKER after drawing the batch — so `next()` hands a
+    ready batch over immediately and the consumer only ever blocks when
+    the next batch genuinely isn't staged yet (waiting for batch k+1
+    before releasing batch k would serialize exactly the work the thread
+    exists to overlap), and the last batch's tag ends the epoch without a
+    final sentinel round-trip. The worker starts lazily on first
+    consumption, so wrapping an iterator (or an epoch-start `reset()`)
+    never stages batches that are immediately thrown away."""
 
     _SENTINEL = object()
 
@@ -410,7 +440,14 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._peek = None
-        self._start()
+        self._more = True      # may the worker still yield items?
+        self._started = False
+
+    def _prepare(self, ds):
+        """Worker-thread hook run on each batch before it is queued —
+        subclasses stage extra work here (DevicePrefetchIterator dispatches
+        the host->device transfer)."""
+        return ds
 
     def _start(self):
         self._queue = queue.Queue(self.queue_size)
@@ -421,50 +458,103 @@ class AsyncDataSetIterator(DataSetIterator):
         # the new generation's (else previous-epoch batches leak in).
         q, stop = self._queue, self._stop
 
+        def put(item):
+            # stop-aware put: an abandoned consumer must not leave this
+            # thread blocked on a full queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def worker():
             try:
-                while self.source.has_next() and not stop.is_set():
-                    q.put(self.source.next())
+                more = self.source.has_next()
+                while more and not stop.is_set():
+                    ds = self.source.next()
+                    more = self.source.has_next()
+                    if not put((self._prepare(ds), more)):
+                        return
             except BaseException as e:  # propagate to consumer
                 self._error = e
-            finally:
-                q.put(self._SENTINEL)
+            # ALWAYS end with a sentinel: an empty source yields no tagged
+            # item at all, so without it the consumer's first _fetch would
+            # block forever. After a fully-tagged epoch the consumer never
+            # reads it (the last tag ended the epoch) — the queue has space
+            # by then and reset()/close() drain it.
+            put(self._SENTINEL)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="dl4j-async-prefetch")
         self._thread.start()
+        self._started = True
         self._peek = None
-        self._fetch()
+        self._more = True
+
+    def _ensure_started(self):
+        if not self._started:
+            self._start()
 
     def _fetch(self):
+        """Block for the next queue entry; resolves end-of-epoch and
+        worker errors."""
         item = self._queue.get()
         if item is self._SENTINEL:
+            self._more = False   # before raising: a caller that catches the
+            self._peek = None    # error and re-polls must not block forever
             if self._error is not None:
-                raise RuntimeError("Async prefetch thread failed") from self._error
-            self._peek = None
+                raise RuntimeError(
+                    "Async prefetch thread failed") from self._error
         else:
-            self._peek = item
+            self._peek, more = item
+            if not more:
+                self._more = False
 
-    def reset(self):
+    def _shutdown(self):
+        """Stop + join the current worker generation (idempotent)."""
+        if self._thread is None:
+            return
         self._stop.set()
-        # drain so the worker unblocks, then restart
+        # drain so a blocked worker unblocks promptly
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self._thread.join(timeout=5)
+        self._thread = None
+
+    def close(self):
+        """Shut the prefetch thread down. The iterator stays resettable:
+        `reset()` (or `__iter__`) restarts a fresh worker."""
+        self._shutdown()
+        self._peek = None
+        self._more = False
+        self._started = True   # don't lazily restart; reset() re-arms
+
+    def reset(self):
+        self._shutdown()
         self.source.reset()
-        self._start()
+        self._peek = None
+        self._more = True
+        self._started = False   # worker restarts on first consumption
 
     def has_next(self):
+        self._ensure_started()
+        if self._peek is not None:
+            return True
+        if not self._more:
+            return False
+        self._fetch()
         return self._peek is not None
 
     def next(self):
-        d = self._peek
-        if d is None:
+        if not self.has_next():
             raise StopIteration
-        self._fetch()
+        d = self._peek
+        self._peek = None
         return d
 
     def batch(self):

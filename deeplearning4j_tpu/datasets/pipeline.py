@@ -1,0 +1,316 @@
+"""Shape-stable, device-prefetched input pipeline.
+
+Two composable stages between any `DataSetIterator` and the fit loops:
+
+  * `PadToBatchIterator` — shape stabilization. Ragged final batches are
+    padded up to the fixed batch size with weight-zero rows (every batch
+    carries a labels mask whose padded rows are zero), and optionally the
+    time axis of sequence data is padded up to a small set of buckets.
+    One batch signature per epoch instead of 2+ means ONE XLA compile of
+    the train step instead of one per distinct shape — the recompile
+    pathology PR 2's CompileWatcher made visible.
+  * `DevicePrefetchIterator` — device prefetch. A background thread runs
+    `DataSet.device_tuple()` (the host->device transfer) one batch ahead,
+    double-buffered, so H2D overlaps the previous step's device compute —
+    the same pipeline `AsyncDataSetIterator` (and the reference's
+    JVM-side double buffering) provides for host batch ASSEMBLY, extended
+    to the transfer itself.
+
+Padding is a provable learning no-op (see `pad_dataset`): the loss is a
+masked mean normalized by the REAL (mask-live) entry count, and the
+models' regularization term is normalized by the live ROW count whenever
+a labels mask is present — so padded rows contribute neither loss nor
+gradient, and the denominator matches the unpadded run. Caveats that
+break exactness: BatchNorm in train mode (batch statistics see the pad
+rows) and dropout (mask shapes differ, so the per-element randomness
+differs) — both stay correct in expectation but are not bitwise-equal to
+the unpadded run.
+
+Donation safety: the jitted train steps donate ONLY params/state/updater
+state (`donate_argnums=(0, 1, 2)`); batch tensors are never donated, so
+buffers transferred by the prefetch thread are never aliased with (or
+invalidated by) a donated argument.
+
+Telemetry (when a session is active): `dl4j_pipeline_rows_total{kind=
+real|pad}` (pad_fraction), `dl4j_pipeline_prefetch_wait_seconds` (how
+long the consumer stalled waiting on the prefetch thread — ~0 means the
+transfer fully overlapped compute), and
+`dl4j_pipeline_bucket_hits_total{bucket=...}` for time bucketing.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .iterators import (AsyncDataSetIterator, DataSet, DataSetIterator,
+                        MultiDataSet)
+
+__all__ = ["PadToBatchIterator", "DevicePrefetchIterator", "pad_dataset",
+           "build_pipeline"]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry plumbing (no-op when no session is active)
+# ---------------------------------------------------------------------------
+def _pipeline_metrics():
+    """(rows counter, prefetch-wait timer, bucket counter) of the active
+    session's registry, or None."""
+    from ..telemetry import runtime
+    tel = runtime.active()
+    if tel is None:
+        return None
+    reg = tel.registry
+    return (reg.counter("dl4j_pipeline_rows_total",
+                        "input-pipeline rows by kind (real vs padding)",
+                        labels=("kind",)),
+            reg.timer("dl4j_pipeline_prefetch_wait_seconds",
+                      "seconds the consumer stalled on the prefetch queue"),
+            reg.counter("dl4j_pipeline_bucket_hits_total",
+                        "batches landing in each time-axis bucket",
+                        labels=("bucket",)))
+
+
+def _count_rows(real: int, pad: int):
+    m = _pipeline_metrics()
+    if m is not None:
+        m[0].inc(real, kind="real")
+        if pad:
+            m[0].inc(pad, kind="pad")
+
+
+def _count_bucket(bucket: int):
+    m = _pipeline_metrics()
+    if m is not None:
+        m[2].inc(1, bucket=str(bucket))
+
+
+# ---------------------------------------------------------------------------
+# Shape stabilization
+# ---------------------------------------------------------------------------
+def _per_example_mask_shape(labels: np.ndarray) -> tuple:
+    """Shape of the per-example loss the losses module reduces over —
+    `[B]` for flat labels, `[B, T]` for sequence labels (losses._apply_mask
+    broadcasts the mask over the trailing feature axis)."""
+    return labels.shape[:-1] if labels.ndim >= 2 else (labels.shape[0],)
+
+
+def _pad_rows(a, n_pad):
+    if a is None or n_pad == 0:
+        return a
+    return np.concatenate(
+        [a, np.zeros((n_pad,) + a.shape[1:], dtype=a.dtype)], axis=0)
+
+
+def _pad_time(a, t_pad, axis=1):
+    if a is None or t_pad == 0 or a.ndim <= axis:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, t_pad)
+    return np.pad(a, widths)
+
+
+def pad_dataset(ds, target_rows: int, time_target: Optional[int] = None):
+    """Pad `ds` (DataSet or MultiDataSet) up to `target_rows` rows (and,
+    for rank>=3 features, up to `time_target` timesteps) with weight-zero
+    entries. Returns `(padded, n_real, n_pad)`.
+
+    The padded dataset ALWAYS carries a labels mask (ones over real
+    entries, zeros over padding) so every batch of an epoch shares one
+    signature and the loss/regularization normalize by the real count.
+    A features mask is synthesized only when the time axis is padded
+    (row-only padding leaves absent features masks absent, preserving
+    the network's unmasked forward path)."""
+    if isinstance(ds, MultiDataSet):
+        return _pad_multi(ds, target_rows)
+    n = ds.num_examples()
+    n_pad = target_rows - n
+    if n_pad < 0:
+        raise ValueError(
+            f"batch of {n} rows exceeds the pipeline batch size "
+            f"{target_rows}; PadToBatchIterator only pads, never splits")
+    feats = np.asarray(ds.features)
+    labels = None if ds.labels is None else np.asarray(ds.labels)
+    fmask = None if ds.features_mask is None else np.asarray(ds.features_mask)
+    lmask = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
+
+    t_pad = 0
+    if time_target is not None and feats.ndim >= 3:
+        t = feats.shape[1]
+        t_pad = time_target - t
+        if t_pad < 0:
+            raise ValueError(
+                f"sequence length {t} exceeds the largest time bucket "
+                f"{time_target}")
+        if t_pad:
+            feats = _pad_time(feats, t_pad)
+            if labels is not None and labels.ndim >= 3:
+                labels = _pad_time(labels, t_pad)
+            lmask = _pad_time(lmask, t_pad)
+        # a padded time axis needs a features mask so recurrent layers see
+        # the true lengths; synthesize one even for t_pad == 0 batches so
+        # bucketed epochs stay signature-stable
+        if fmask is None:
+            fmask = np.zeros(feats.shape[:2], np.float32)
+            fmask[:n, :feats.shape[1] - t_pad] = 1.0
+        else:
+            fmask = _pad_time(fmask, t_pad)
+
+    if lmask is None and labels is not None:
+        shape = _per_example_mask_shape(labels)
+        lmask = np.ones(shape, np.float32)
+        if t_pad and len(shape) >= 2:
+            lmask[:, shape[1] - t_pad:] = 0.0
+    feats = _pad_rows(feats, n_pad)
+    labels = _pad_rows(labels, n_pad)
+    fmask = _pad_rows(fmask, n_pad)
+    lmask = _pad_rows(lmask, n_pad)
+    return DataSet(feats, labels, fmask, lmask), n, n_pad
+
+
+def _pad_multi(ds: MultiDataSet, target_rows: int):
+    """Row padding for MultiDataSet (time bucketing is single-DataSet
+    only): every output gets a labels mask with zero pad rows."""
+    n = ds.num_examples()
+    n_pad = target_rows - n
+    if n_pad < 0:
+        raise ValueError(
+            f"batch of {n} rows exceeds the pipeline batch size "
+            f"{target_rows}; PadToBatchIterator only pads, never splits")
+    feats = [_pad_rows(np.asarray(a), n_pad) for a in ds.features]
+    labels = [_pad_rows(np.asarray(a), n_pad) for a in ds.labels]
+    fmasks = None
+    if ds.features_masks is not None:
+        fmasks = [None if m is None else _pad_rows(np.asarray(m), n_pad)
+                  for m in ds.features_masks]
+    lmasks = list(ds.labels_masks) if ds.labels_masks is not None \
+        else [None] * len(ds.labels)
+    for i, (lab, m) in enumerate(zip(ds.labels, lmasks)):
+        if m is None:
+            m = np.ones(_per_example_mask_shape(np.asarray(lab)), np.float32)
+        else:
+            m = np.asarray(m)
+        lmasks[i] = _pad_rows(m, n_pad)
+    return MultiDataSet(features=feats, labels=labels,
+                        features_masks=fmasks, labels_masks=lmasks), n, n_pad
+
+
+class PadToBatchIterator(DataSetIterator):
+    """Pads every batch of `source` up to a fixed row count (and optional
+    time buckets) with weight-zero entries — see `pad_dataset` for the
+    no-op argument. Batch size comes from `batch_size`, else
+    `source.batch()`, else lazily from the first batch of the epoch
+    (standard iterators emit full batches first, ragged batch last).
+
+    `time_buckets`: ascending sequence of allowed sequence lengths; each
+    rank-3 batch is padded up to the smallest bucket >= its length, so an
+    epoch of arbitrary lengths produces at most `len(time_buckets)`
+    signatures."""
+
+    def __init__(self, source: DataSetIterator, batch_size: Optional[int] = None,
+                 time_buckets: Optional[Sequence[int]] = None):
+        self.source = source
+        declared = int(batch_size) if batch_size else 0
+        if declared <= 0:
+            declared = int(getattr(source, "batch", lambda: 0)() or 0)
+        self._target = declared if declared > 0 else None
+        self._target_inferred = self._target is None
+        self.time_buckets = (tuple(sorted(int(b) for b in time_buckets))
+                             if time_buckets else None)
+        self.pad_rows = 0
+        self.real_rows = 0
+
+    def _bucket_for(self, t: int) -> int:
+        for b in self.time_buckets:
+            if t <= b:
+                return b
+        raise ValueError(
+            f"sequence length {t} exceeds the largest time bucket "
+            f"{self.time_buckets[-1]}")
+
+    def reset(self):
+        self.source.reset()
+
+    def has_next(self) -> bool:
+        return self.source.has_next()
+
+    def next(self) -> DataSet:
+        ds = self.source.next()
+        n = ds.num_examples()
+        if self._target is None:
+            self._target = n
+        elif self._target_inferred and n > self._target:
+            # the lazy inference assumed full-batches-first (the standard
+            # iterator layout); a growing batch means it guessed wrong
+            raise ValueError(
+                f"batch of {n} rows exceeds the batch size {self._target} "
+                "inferred from this epoch's first batch; pass "
+                "PadToBatchIterator(batch_size=...) explicitly for sources "
+                "whose batch() is unknown and whose first batch is not "
+                "full-size")
+        time_target = None
+        if (self.time_buckets is not None and not isinstance(ds, MultiDataSet)
+                and np.asarray(ds.features).ndim >= 3):
+            time_target = self._bucket_for(np.asarray(ds.features).shape[1])
+            _count_bucket(time_target)
+        padded, n_real, n_pad = pad_dataset(ds, self._target, time_target)
+        self.real_rows += n_real
+        self.pad_rows += n_pad
+        _count_rows(n_real, n_pad)
+        return padded
+
+    def batch(self) -> int:
+        return self._target or self.source.batch()
+
+    @property
+    def pad_fraction(self) -> float:
+        total = self.real_rows + self.pad_rows
+        return self.pad_rows / total if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Device prefetch
+# ---------------------------------------------------------------------------
+class DevicePrefetchIterator(AsyncDataSetIterator):
+    """Background-thread DEVICE prefetch: one batch ahead, the worker runs
+    `device_tuple()` — dispatching the host->device transfer — so the
+    consumer's `device_tuple()` call is a cache hit and H2D overlaps the
+    previous step's compute (double buffering, the `AsyncDataSetIterator`
+    contract extended from host assembly to the transfer).
+
+    Donation-safe by construction: the fit paths donate only
+    params/state/updater-state to the jitted step; batch tensors (the only
+    thing this thread touches) are never donated. Accepts DataSet and
+    MultiDataSet sources alike (both expose `device_tuple`)."""
+
+    def _prepare(self, ds):
+        ds.device_tuple()   # async dispatch: transfer starts NOW
+        return ds
+
+    def _fetch(self):
+        m = _pipeline_metrics()
+        if m is None:
+            return super()._fetch()
+        with m[1].time():
+            return super()._fetch()
+
+
+# ---------------------------------------------------------------------------
+# Fit-path assembly
+# ---------------------------------------------------------------------------
+def build_pipeline(data: DataSetIterator, *, pad_ragged: bool = False,
+                   prefetch: bool = False,
+                   batch_size: Optional[int] = None,
+                   time_buckets: Optional[Sequence[int]] = None,
+                   queue_size: int = 2) -> Tuple[DataSetIterator, callable]:
+    """Wrap `data` with the requested pipeline stages. Returns
+    `(iterator, close)`; callers MUST invoke `close()` when done so the
+    prefetch thread shuts down instead of leaking across fits."""
+    it = data
+    if pad_ragged or time_buckets:
+        it = PadToBatchIterator(it, batch_size=batch_size,
+                                time_buckets=time_buckets)
+    if prefetch and getattr(data, "async_supported", True):
+        it = DevicePrefetchIterator(it, queue_size=queue_size)
+        return it, it.close
+    return it, lambda: None

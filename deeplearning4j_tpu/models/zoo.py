@@ -16,9 +16,9 @@ from ..nn.layers import (ConvolutionLayer, ConvolutionMode, DenseLayer,
 from ..nn.multilayer import MultiLayerNetwork
 from ..nn.updaters import Adam, Nesterovs
 
-__all__ = ["lenet_mnist", "bench_lenet", "mlp_mnist", "char_rnn",
-           "bench_char_rnn", "resnet50", "bench_resnet50", "vgg16",
-           "vgg19", "alexnet", "googlenet", "sample_characters"]
+__all__ = ["lenet_mnist", "bench_lenet", "bench_lenet_ragged", "mlp_mnist",
+           "char_rnn", "bench_char_rnn", "resnet50", "bench_resnet50",
+           "vgg16", "vgg19", "alexnet", "googlenet", "sample_characters"]
 
 
 def lenet_mnist(seed: int = 42, updater=None) -> MultiLayerNetwork:
@@ -325,6 +325,79 @@ def bench_char_rnn_dispatch(batch: int = 64, seq_len: int = 128,
     float(model.score())
     dt = time.perf_counter() - t0
     return batch * seq_len * steps / dt, "charRNN-tokens-dispatch"
+
+
+def bench_lenet_ragged(batch: int = 256, full_batches: int = 5,
+                       ragged: int = 255, epochs: int = 4, warmup: int = 1):
+    """Ragged-final-batch LeNet through the per-batch fit() path, three
+    ways — the input-pipeline before/after artifact (ISSUE 3):
+
+      serial           plain iterator: the ragged tail costs a SECOND
+                       nn/train_step compile (the HEAD pathology)
+      padded           fit(pad_ragged=True): weight-zero padding, ONE
+                       compile, pad_fraction reported
+      padded_prefetch  + fit(prefetch=True): device_tuple() staged one
+                       batch ahead on a background thread
+
+    Each variant runs under its OWN telemetry session on a FRESH model so
+    compile counts attribute cleanly. Timing excludes the warmup epoch
+    (compiles); samples/sec counts REAL rows only, so serial and padded
+    are directly comparable."""
+    from ..datasets.iterators import ArrayDataSetIterator
+    from ..telemetry import runtime as telemetry_runtime
+    from ..telemetry.runtime import TelemetrySession
+
+    n = batch * full_batches + ragged
+    r = np.random.default_rng(0)
+    x = r.normal(size=(n, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, n)]
+    variants = (("serial", {}),
+                ("padded", dict(pad_ragged=True)),
+                ("padded_prefetch", dict(pad_ragged=True, prefetch=True)))
+    state = {}
+    for name, kw in variants:   # per-variant session + model: compile
+        sess = TelemetrySession()   # counts attribute cleanly
+        model = lenet_mnist().init()
+        it = ArrayDataSetIterator(x, y, batch_size=batch)
+        with telemetry_runtime.enabled(sess):
+            model.fit(it, epochs=warmup, **kw)   # pays the compiles
+            float(model.score())
+        state[name] = (sess, model, it, kw, [])
+    rounds = []
+    for _ in range(3):   # ALTERNATING reps: clock/thermal drift hits every
+        times = {}       # variant equally, not just the last one
+        for name, kw in variants:
+            sess, model, it, kw, reps = state[name]
+            with telemetry_runtime.enabled(sess):
+                t0 = time.perf_counter()
+                model.fit(it, epochs=epochs, **kw)
+                float(model.score())
+                times[name] = time.perf_counter() - t0
+                reps.append(times[name])
+        rounds.append(times)
+    out = {}
+    steps = (full_batches + 1) * epochs
+    for name, _ in variants:
+        sess, model, it, kw, reps = state[name]
+        reps.sort()
+        dt = reps[len(reps) // 2]
+        rec = {"samples_per_s": round(n * epochs / dt, 1),
+               "steps_per_s": round(steps / dt, 2),
+               "steps_per_s-spread": [round(steps / reps[-1], 2),
+                                      round(steps / reps[0], 2)],
+               "train_step_compiles": sess.compiles.count("nn/train_step")}
+        pipe = sess.pipeline_summary()
+        if pipe:
+            rec["pipeline"] = pipe
+        out[name] = rec
+    # paired per-round comparison: each round's variants run back-to-back,
+    # so the host's load/thermal drift (which swamps a sub-1% effect across
+    # minutes) cancels; ratio > 1 means prefetch was faster that round
+    ratios = sorted(r["serial"] / r["padded_prefetch"] for r in rounds)
+    out["prefetch_vs_serial_paired_ratio"] = round(
+        ratios[len(ratios) // 2], 4)
+    out["prefetch_ge_serial"] = ratios[len(ratios) // 2] >= 1.0
+    return out
 
 
 def alexnet(n_classes: int = 1000, image: int = 224, seed: int = 42,

@@ -291,6 +291,8 @@ class ComputationGraph:
             params, state, inputs, train, rng, fmasks, stop_at_outputs=True)
         total = jnp.float32(0.0)
         batch = next(iter(inputs.values())).shape[0]
+        live = jnp.zeros((batch,), jnp.float32)
+        all_masked = True
         for i, name in enumerate(self.conf.network_outputs):
             v = self.conf.vertices[name]
             if not isinstance(v, BaseOutputLayerConf):
@@ -307,6 +309,17 @@ class ComputationGraph:
             total = total + v.loss_score(params[name], state[name], x,
                                          labels[name], train=train,
                                          rng=out_rng, mask=eff)
+            if eff is None:
+                all_masked = False
+            else:
+                live = jnp.maximum(live, eff.astype(jnp.float32).reshape(
+                    (eff.shape[0], -1)).max(axis=1))
+        # Regularization normalizes by REAL rows (live in ANY output's
+        # mask), not the padded batch size, so PadToBatchIterator's
+        # weight-zero rows are a learning no-op (each output's loss is
+        # already a masked mean); an unmasked output counts every row
+        if all_masked:
+            batch = jnp.maximum(jnp.sum(live), 1.0)
         score = total + self._reg_score(params) / batch
         # layer auxiliary losses (MoE router load balancing) — train only
         if train:
@@ -454,17 +467,30 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     # Public API
     # ------------------------------------------------------------------
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
+            pad_ragged: bool = False, time_buckets=None):
+        """fit(DataSet/MultiDataSet) or fit(iterator). `pad_ragged` pads
+        ragged final batches to the fixed batch size with weight-zero rows
+        (one train-step compile per fit, learning no-op); `prefetch` moves
+        `device_tuple()` to a background thread one batch ahead so
+        host->device transfer overlaps compute (see datasets/pipeline.py)."""
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
             return self
-        for _ in range(epochs):
-            data.reset()
-            while data.has_next():
-                self._fit_batch(data.next())
-            self.epoch_count += 1
+        from ..datasets.pipeline import build_pipeline
+        data, close = build_pipeline(data, pad_ragged=pad_ragged,
+                                     prefetch=prefetch,
+                                     time_buckets=time_buckets)
+        try:
+            for _ in range(epochs):
+                data.reset()
+                while data.has_next():
+                    self._fit_batch(data.next())
+                self.epoch_count += 1
+        finally:
+            close()
         return self
 
     @_functools.cached_property

@@ -243,7 +243,14 @@ class MultiLayerNetwork:
             mask if mask is not None else None)
         loss = out_layer.loss_score(params[-1], state[-1], h, y,
                                     train=train, rng=out_rng, mask=eff_lmask)
+        # Regularization normalizes by REAL rows (any live mask entry), not
+        # the padded batch size, so PadToBatchIterator's weight-zero rows
+        # are a learning no-op (the loss itself is already a masked mean)
         batch = x.shape[0]
+        if eff_lmask is not None:
+            live = eff_lmask.astype(jnp.float32).reshape(
+                (eff_lmask.shape[0], -1)).max(axis=1)
+            batch = jnp.maximum(jnp.sum(live), 1.0)
         score = loss + self._reg_score(params) / batch
         # layer auxiliary losses from the state side-channel (MoE router
         # load balancing, nn/layers/moe.py) — train only: eval state holds
@@ -388,8 +395,25 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Public training API
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
-        """fit(DataSetIterator), fit(DataSet), or fit(features, labels)."""
+    def fit(self, data, labels=None, epochs: int = 1, *,
+            prefetch: bool = False, pad_ragged: bool = False,
+            time_buckets=None):
+        """fit(DataSetIterator), fit(DataSet), or fit(features, labels).
+
+        Input-pipeline knobs (iterator inputs only; see
+        `datasets/pipeline.py`):
+          pad_ragged    — pad ragged final batches to the fixed batch size
+                          with weight-zero rows: ONE train-step compile per
+                          fit instead of one per distinct batch shape, and
+                          a provable learning no-op (loss and
+                          regularization normalize by real rows).
+          time_buckets  — with pad_ragged semantics, additionally pad the
+                          time axis of sequence batches up to these bucket
+                          lengths (at most len(buckets) signatures).
+          prefetch      — stage `device_tuple()` on a background thread one
+                          batch ahead so host->device transfer overlaps the
+                          previous step's compute (donation-safe: batch
+                          tensors are never donated)."""
         if self.params is None:
             self.init()
         if labels is not None:
@@ -404,23 +428,30 @@ class MultiLayerNetwork:
             self._pretrained = True
         if not self.conf.backprop:
             return self
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            data.reset()
-            while data.has_next():
-                self._fit_batch(data.next())
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch_count += 1
+        from ..datasets.pipeline import build_pipeline
+        data, close = build_pipeline(data, pad_ragged=pad_ragged,
+                                     prefetch=prefetch,
+                                     time_buckets=time_buckets)
+        try:
+            for _ in range(epochs):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                data.reset()
+                while data.has_next():
+                    self._fit_batch(data.next())
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch_count += 1
+        finally:
+            close()
         return self
 
     # ------------------------------------------------------------------
     # Device-resident epoch training (one dispatch per epoch)
     # ------------------------------------------------------------------
-    def fit_scan(self, data, epochs: int = 1):
+    def fit_scan(self, data, epochs: int = 1, *, pad_ragged: bool = False):
         """Stack the dataset's batches into [T, ...] device arrays and
         `lax.scan` the train step — ONE device dispatch per epoch instead of
         one per batch. This matters whenever per-dispatch latency is
@@ -460,12 +491,17 @@ class MultiLayerNetwork:
             batches = list(data)
         if not batches:
             return self
+        if pad_ragged:
+            from ..datasets.pipeline import pad_dataset
+            target = max(b.num_examples() for b in batches)
+            batches = [pad_dataset(b, target)[0] for b in batches]
         shapes = {tuple(np.asarray(b.features).shape) for b in batches}
         if len(shapes) != 1:
             raise ValueError(
                 f"fit_scan needs uniform batch shapes, got {sorted(shapes)}; "
-                "pad or drop the ragged tail (ArrayDataSetIterator drops it "
-                "with drop_last=True) or use fit()")
+                "pad the ragged tail (pad_ragged=True — weight-zero rows, "
+                "a learning no-op), drop it (ArrayDataSetIterator("
+                "drop_last=True)), or use fit()")
         xs = np.stack([np.asarray(b.features) for b in batches])
         ys = np.stack([np.asarray(b.labels) for b in batches])
 
@@ -652,8 +688,9 @@ class MultiLayerNetwork:
                 log.info(
                     "train step retracing for a second batch signature %s — "
                     "ragged final batches double compile time; use "
-                    "ArrayDataSetIterator(drop_last=True) or pad batches "
-                    "to a fixed size", sig)
+                    "fit(..., pad_ragged=True) (weight-zero padding, a "
+                    "learning no-op) or ArrayDataSetIterator("
+                    "drop_last=True)", sig)
 
     def _check_input_width(self, x):
         """Fail with a named error instead of a raw XLA shape error when the

@@ -273,12 +273,16 @@ def _telemetry_fields(sess):
     (one line artifact: a regressed efficiency number is diagnosable as
     compile churn vs collective overhead without re-running)."""
     spans = sess.span_totals()
-    return {"xla_compilations": sess.compiles.total(),
-            "compiles": {k: v["count"]
-                         for k, v in sess.compiles.report().items()},
-            "dispatch_seconds": round(spans.get("device/dispatch", 0.0), 4),
-            "sync_seconds": round(spans.get("device/sync", 0.0), 4),
-            "peak_rss_mb": round(sess.watermarks.peak_rss_mb(), 1)}
+    out = {"xla_compilations": sess.compiles.total(),
+           "compiles": {k: v["count"]
+                        for k, v in sess.compiles.report().items()},
+           "dispatch_seconds": round(spans.get("device/dispatch", 0.0), 4),
+           "sync_seconds": round(spans.get("device/sync", 0.0), 4),
+           "peak_rss_mb": round(sess.watermarks.peak_rss_mb(), 1)}
+    pipe = sess.pipeline_summary()
+    if pipe:
+        out["pipeline"] = pipe
+    return out
 
 
 def main(argv=None):

@@ -216,7 +216,15 @@ class ParallelTrainer:
             jax.random.PRNGKey(0)
 
     # ------------------------------------------------------------------
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
+            pad_ragged: bool = False, time_buckets=None):
+        """`pad_ragged` pads ragged final batches up to the fixed batch
+        size with weight-zero mask rows (the same `_pad_to` zero-fill, made
+        a learning no-op by mask-normalized loss/regularization) — every
+        example trains instead of the remainder being dropped, and the
+        sharded step keeps ONE signature. `prefetch` stages
+        `device_tuple()` one batch ahead on a background thread (see
+        datasets/pipeline.py)."""
         if self._pipe is not None:
             self._pipe.fit(data, epochs=epochs)
             self.iteration_count = self._pipe.iteration_count
@@ -224,11 +232,19 @@ class ParallelTrainer:
             return self
         if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
-        else:
+            self._sync_back()
+            return self
+        from ..datasets.pipeline import build_pipeline
+        data, close = build_pipeline(data, pad_ragged=pad_ragged,
+                                     prefetch=prefetch,
+                                     time_buckets=time_buckets)
+        try:
             for _ in range(epochs):
                 data.reset()
                 while data.has_next():
                     self._fit_batch(data.next())
+        finally:
+            close()
         self._sync_back()
         return self
 
@@ -251,11 +267,9 @@ class ParallelTrainer:
         if isinstance(self.model, ComputationGraph):
             inputs, labels, fmasks, lmasks = self.model._to_inputs(ds)
             return inputs, labels, none_free(fmasks), none_free(lmasks)
-        fm = ds.features_mask
-        lm = ds.labels_mask
-        return (jnp.asarray(ds.features), jnp.asarray(ds.labels),
-                None if fm is None else jnp.asarray(fm),
-                None if lm is None else jnp.asarray(lm))
+        # device_tuple() (not raw jnp.asarray) so a DevicePrefetchIterator's
+        # staged transfer is a cache HIT here instead of a second H2D copy
+        return ds.device_tuple()
 
     def _fit_batch(self, ds: DataSet):
         import contextlib
@@ -273,9 +287,10 @@ class ParallelTrainer:
             n_div = (max(1, n // jax.process_count()) if local_shard else n)
             bs = jax.tree_util.tree_leaves(xd)[0].shape[0]
             if bs % n_div:
-                # pad the global batch to a multiple of the data axis (the
-                # reference round-robins leftovers; padding + weight-0 would
-                # alter loss scale — we simply drop the remainder)
+                # the remainder is dropped (the reference round-robins
+                # leftovers); fit(pad_ragged=True) instead pads up to the
+                # fixed batch size with weight-zero mask rows upstream, so
+                # every example trains and the step keeps one signature
                 keep = (bs // n_div) * n_div
                 if keep == 0:
                     return

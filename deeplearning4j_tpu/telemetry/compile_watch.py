@@ -115,8 +115,9 @@ class CompileWatcher:
                 f"XLA recompilation storm: '{name}' has compiled {total} "
                 f"times (> {self.storm_threshold}). Every distinct batch "
                 "signature recompiles the whole step — pad batches to a "
-                "fixed size or drop the ragged tail "
-                "(ArrayDataSetIterator(drop_last=True))",
+                "fixed size (fit(..., pad_ragged=True) / "
+                "datasets.pipeline.PadToBatchIterator) or drop the ragged "
+                "tail (ArrayDataSetIterator(drop_last=True))",
                 RecompilationStormWarning, stacklevel=3)
 
     def count(self, name: str) -> int:

@@ -119,11 +119,35 @@ class TelemetrySession:
         """{span name: total wall seconds} from the aggregate histogram."""
         return {k[0]: v for k, v in self.span_seconds.sums().items()}
 
+    def pipeline_summary(self) -> Dict:
+        """Input-pipeline metrics (datasets/pipeline.py): pad_fraction
+        (weight-zero padding rows / all rows), prefetch wait (consumer
+        stall on the device-prefetch queue — ~0 means transfer fully
+        overlapped compute), time-bucket hit counts. Empty dict when no
+        pipeline stage ran under this session."""
+        out: Dict = {}
+        rows = self.registry.get("dl4j_pipeline_rows_total")
+        if rows is not None:
+            real = rows.value(kind="real")
+            pad = rows.value(kind="pad")
+            if real + pad:
+                out["rows"] = int(real + pad)
+                out["pad_fraction"] = round(pad / (real + pad), 4)
+        wait = self.registry.get("dl4j_pipeline_prefetch_wait_seconds")
+        if wait is not None and wait.count():
+            out["prefetch_waits"] = wait.count()
+            out["prefetch_wait_s"] = round(wait.sum(), 4)
+        buckets = self.registry.get("dl4j_pipeline_bucket_hits_total")
+        if buckets is not None and buckets.values():
+            out["bucket_hits"] = {k[0]: int(v)
+                                  for k, v in sorted(buckets.values().items())}
+        return out
+
     def summary(self) -> Dict:
         """The compact dict bench.py embeds as extras.telemetry."""
         rep = self.compiles.report()
         self.watermarks.sample()
-        return {
+        out = {
             "xla_compilations": self.compiles.total(),
             "compiles": {k: v["count"] for k, v in rep.items()},
             "compile_wall_s": round(sum(v["wall_s"] for v in rep.values()),
@@ -133,6 +157,10 @@ class TelemetrySession:
             "peak_rss_mb": round(self.watermarks.peak_rss_mb(), 1),
             "trace_events": len(self.tracer),
         }
+        pipe = self.pipeline_summary()
+        if pipe:
+            out["pipeline"] = pipe
+        return out
 
 
 _active: Optional[TelemetrySession] = None

@@ -5,11 +5,18 @@
 #   ./runtests.sh 5          five consecutive passes (stop on first failure)
 #   ./runtests.sh telemetry  telemetry smoke only (registry/tracing/compile
 #                            watcher; tmp_path-only file writes, no network)
+#   ./runtests.sh pipeline   input-pipeline smoke only (PadToBatch /
+#                            DevicePrefetch, ragged-batch compile counts,
+#                            async iterator lifecycle)
 set -euo pipefail
 cd "$(dirname "$0")"
 if [[ "${1:-}" == "telemetry" ]]; then
     echo "=== telemetry smoke ==="
     exec python -m pytest tests/test_telemetry.py -q
+fi
+if [[ "${1:-}" == "pipeline" ]]; then
+    echo "=== input-pipeline smoke ==="
+    exec python -m pytest tests/test_input_pipeline.py -q
 fi
 runs="${1:-1}"
 for i in $(seq 1 "$runs"); do

@@ -1,0 +1,427 @@
+"""Input-pipeline tests (ISSUE 3): shape stabilization (PadToBatchIterator
+weight-zero padding as a provable learning no-op, single train-step compile
+on ragged datasets, time-axis bucketing) and device prefetch
+(DevicePrefetchIterator overlap, error propagation, clean thread shutdown) —
+plus the iterator satellite fixes (drop_last zero-iteration warning,
+first-epoch shuffle reproducibility, AsyncDataSetIterator lifecycle).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.datasets.iterators import (ArrayDataSetIterator,
+                                                   AsyncDataSetIterator,
+                                                   DataSet, DataSetIterator,
+                                                   ListDataSetIterator,
+                                                   ExistingDataSetIterator,
+                                                   MultiDataSet)
+from deeplearning4j_tpu.datasets.pipeline import (DevicePrefetchIterator,
+                                                  PadToBatchIterator,
+                                                  build_pipeline, pad_dataset)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+
+
+def _mlp(seed=7, l2=1e-3):
+    # l2 regularization ON so the test also proves the reg term normalizes
+    # by REAL rows (the padded run would otherwise divide by the padded
+    # batch size and drift from the unpadded baseline)
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .l2(l2)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=50, n_in=8, n_out=3, seed=1):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[r.integers(0, n_out, n)]
+    return x, y
+
+
+def _wait_threads(n0, timeout=5.0):
+    """Wait until the live thread count is back to <= n0."""
+    deadline = time.time() + timeout
+    while threading.active_count() > n0 and time.time() < deadline:
+        time.sleep(0.01)
+    return threading.active_count()
+
+
+# ---------------------------------------------------------------------------
+# PadToBatchIterator — shape stabilization
+# ---------------------------------------------------------------------------
+
+def test_pad_to_batch_shapes_and_masks():
+    x, y = _data(50)
+    it = PadToBatchIterator(ArrayDataSetIterator(x, y, batch_size=16))
+    batches = list(it)
+    assert len(batches) == 4
+    for b in batches:
+        assert b.features.shape[0] == 16
+        assert b.labels.shape[0] == 16
+        assert b.labels_mask is not None and b.labels_mask.shape == (16,)
+    # full batches: all-live mask; ragged final batch: 2 real + 14 pad
+    for b in batches[:-1]:
+        assert b.labels_mask.sum() == 16
+    last = batches[-1]
+    assert last.labels_mask.sum() == 2
+    np.testing.assert_array_equal(last.labels_mask[:2], 1.0)
+    np.testing.assert_array_equal(last.features[2:], 0.0)
+    # row-only padding must NOT invent a features mask (that would change
+    # the network's unmasked forward path / signature)
+    assert last.features_mask is None
+    assert it.pad_fraction == pytest.approx(14 / 64)
+
+
+def test_pad_to_batch_infers_batch_size_lazily():
+    # ExistingDataSetIterator.batch() == -1: target comes from the first
+    # batch of the epoch
+    x, y = _data(20)
+    dss = [DataSet(x[:8], y[:8]), DataSet(x[8:16], y[8:16]),
+           DataSet(x[16:], y[16:])]
+    it = PadToBatchIterator(ExistingDataSetIterator(dss))
+    batches = list(it)
+    assert [b.features.shape[0] for b in batches] == [8, 8, 8]
+    assert batches[-1].labels_mask.sum() == 4
+
+
+def test_pad_to_batch_rejects_oversize_batch():
+    x, y = _data(20)
+    it = PadToBatchIterator(ArrayDataSetIterator(x, y, batch_size=12),
+                            batch_size=8)
+    with pytest.raises(ValueError, match="only pads, never splits"):
+        next(iter(it))
+
+
+def test_pad_dataset_multidataset():
+    r = np.random.default_rng(0)
+    mds = MultiDataSet(
+        features=[r.normal(size=(5, 4)).astype(np.float32)],
+        labels=[np.eye(3, dtype=np.float32)[r.integers(0, 3, 5)],
+                r.normal(size=(5, 2)).astype(np.float32)])
+    padded, n_real, n_pad = pad_dataset(mds, 8)
+    assert (n_real, n_pad) == (5, 3)
+    assert padded.features[0].shape == (8, 4)
+    assert [l.shape[0] for l in padded.labels] == [8, 8]
+    assert len(padded.labels_masks) == 2
+    for m in padded.labels_masks:
+        assert m.shape == (8,)
+        np.testing.assert_array_equal(m, [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_time_buckets_stabilize_sequence_shapes():
+    mk = lambda b, t: DataSet(
+        np.ones((b, t, 3), np.float32),
+        np.ones((b, t, 2), np.float32))
+    dss = [mk(4, 5), mk(4, 9), mk(2, 16)]
+    with telemetry.enabled() as sess:
+        it = PadToBatchIterator(ListDataSetIterator(dss), batch_size=4,
+                                time_buckets=(8, 16))
+        out = list(it)
+        assert [b.features.shape for b in out] == [
+            (4, 8, 3), (4, 16, 3), (4, 16, 3)]
+        # features masks are synthesized on the bucketed path (recurrent
+        # layers must see true lengths) and mark real timesteps only
+        assert out[0].features_mask.shape == (4, 8)
+        np.testing.assert_array_equal(out[0].features_mask[:, :5], 1.0)
+        np.testing.assert_array_equal(out[0].features_mask[:, 5:], 0.0)
+        # labels mask: zero over padded timesteps AND padded rows
+        assert out[0].labels_mask.shape == (4, 8)
+        np.testing.assert_array_equal(out[0].labels_mask[:, 5:], 0.0)
+        assert out[2].labels_mask[2:].sum() == 0   # padded rows
+        pipe = sess.pipeline_summary()
+        assert pipe["bucket_hits"] == {"8": 1, "16": 2}
+    with pytest.raises(ValueError, match="exceeds the largest time bucket"):
+        list(PadToBatchIterator(ListDataSetIterator([mk(4, 32)]),
+                                batch_size=4, time_buckets=(8, 16)))
+
+
+def test_padded_training_is_learning_noop():
+    """Satellite: params and score after fitting a ragged dataset through
+    the padding pipeline match the unpadded fit() baseline to tolerance
+    (weight-zero rows contribute no loss, no gradient, and the l2 term
+    normalizes by real rows)."""
+    x, y = _data(50)
+    base = _mlp()
+    padded = _mlp()
+    base.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=3)
+    padded.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=3,
+               pad_ragged=True)
+    np.testing.assert_allclose(padded.params_flat(), base.params_flat(),
+                               rtol=1e-4, atol=1e-6)
+    ds = DataSet(x, y)
+    assert float(padded.score(ds)) == pytest.approx(float(base.score(ds)),
+                                                    rel=1e-4)
+
+
+def test_pad_ragged_single_compile():
+    """The acceptance criterion: ONE nn/train_step compile on a ragged
+    dataset with pad_ragged=True, two without."""
+    x, y = _data(50)
+    with telemetry.enabled() as sess:
+        _mlp().fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+        assert sess.compiles.count("nn/train_step") == 2
+    with telemetry.enabled() as sess:
+        m = _mlp()
+        m.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2,
+              pad_ragged=True)
+        assert sess.compiles.count("nn/train_step") == 1
+        assert m.recompile_count == 1
+        pipe = sess.pipeline_summary()
+        assert pipe["pad_fraction"] == pytest.approx(14 / 64, abs=1e-3)
+        assert pipe["rows"] == 128
+
+
+def test_graph_pad_ragged_single_compile():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build())
+    x, y = _data(50)
+    with telemetry.enabled() as sess:
+        g = ComputationGraph(conf).init()
+        g.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2,
+              pad_ragged=True)
+        assert sess.compiles.count("graph/train_step") == 1
+
+
+def test_fit_scan_pad_ragged():
+    x, y = _data(50)
+    m = _mlp()
+    # without padding the ragged tail is a hard error on the scan path
+    with pytest.raises(ValueError, match="uniform batch shapes"):
+        _mlp().fit_scan(ArrayDataSetIterator(x, y, batch_size=16))
+    m.fit_scan(ArrayDataSetIterator(x, y, batch_size=16), pad_ragged=True)
+    assert np.isfinite(float(m.score(DataSet(x, y))))
+
+
+def test_parallel_trainer_pad_ragged():
+    from deeplearning4j_tpu.parallel import ParallelTrainer, make_mesh
+
+    import jax
+
+    x, y = _data(26)
+    base = _mlp()
+    tr = ParallelTrainer(_mlp(),
+                         mesh=make_mesh({"data": 2},
+                                        devices=jax.devices()[:2]))
+    tr.fit(ArrayDataSetIterator(x, y, batch_size=8), pad_ragged=True)
+    assert np.isfinite(tr.score())
+    # every example trained: params moved off the (identically-seeded)
+    # untrained baseline
+    assert not np.allclose(tr.model.params_flat(), base.params_flat())
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetchIterator — device prefetch
+# ---------------------------------------------------------------------------
+
+def test_prefetch_matches_serial_and_joins_threads():
+    x, y = _data(64)
+    base = _mlp()
+    base.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    n0 = threading.active_count()
+    pre = _mlp()
+    pre.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2,
+            prefetch=True)
+    # fit() closed the prefetch thread on exit
+    assert _wait_threads(n0) <= n0
+    np.testing.assert_allclose(pre.params_flat(), base.params_flat(),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_prefetch_wait_telemetry_recorded():
+    x, y = _data(64)
+    with telemetry.enabled() as sess:
+        _mlp().fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2,
+                   prefetch=True, pad_ragged=True)
+        pipe = sess.pipeline_summary()
+        assert pipe["prefetch_waits"] > 0
+        assert pipe["prefetch_wait_s"] >= 0.0
+        assert pipe["pad_fraction"] == 0.0   # 64 divides evenly
+
+
+class _FailingIterator(DataSetIterator):
+    """Yields `good` batches, then raises from next() — exercises
+    worker-thread error propagation."""
+
+    def __init__(self, good=1, batch_size=4):
+        self.good = good
+        self.batch_size = batch_size
+        self.reset()
+
+    def reset(self):
+        self._served = 0
+
+    def has_next(self):
+        return True
+
+    def next(self):
+        if self._served >= self.good:
+            raise ValueError("boom")
+        self._served += 1
+        x = np.zeros((self.batch_size, 8), np.float32)
+        y = np.eye(3, dtype=np.float32)[np.zeros(self.batch_size, int)]
+        return DataSet(x, y)
+
+    def batch(self):
+        return self.batch_size
+
+
+def test_prefetch_error_propagates():
+    # every good batch is consumable; the error surfaces on the fetch
+    # after the last one
+    it = DevicePrefetchIterator(_FailingIterator(good=2))
+    got = [it.next()]
+    with pytest.raises(RuntimeError, match="prefetch thread failed") as ei:
+        while it.has_next():
+            got.append(it.next())
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert len(got) == 2
+    it.close()
+
+
+def test_build_pipeline_composes_and_closes():
+    x, y = _data(50)
+    it, close = build_pipeline(ArrayDataSetIterator(x, y, batch_size=16),
+                               pad_ragged=True, prefetch=True)
+    assert isinstance(it, DevicePrefetchIterator)
+    assert isinstance(it.source, PadToBatchIterator)
+    total = sum(b.num_examples() for b in it)
+    assert total == 64   # 50 real + 14 pad
+    close()
+    assert not it.has_next()
+
+
+# ---------------------------------------------------------------------------
+# AsyncDataSetIterator lifecycle (satellite)
+# ---------------------------------------------------------------------------
+
+def test_async_error_propagation():
+    it = AsyncDataSetIterator(_FailingIterator(good=1))
+    assert it.next() is not None
+    with pytest.raises(RuntimeError, match="prefetch thread failed") as ei:
+        it.next()
+    assert isinstance(ei.value.__cause__, ValueError)
+    it.close()
+
+
+def test_async_reset_mid_epoch():
+    x, y = _data(40)
+    it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8),
+                              queue_size=2)
+    it.next()
+    it.next()
+    it.reset()
+    batches = []
+    while it.has_next():
+        batches.append(it.next())
+    assert sum(b.num_examples() for b in batches) == 40
+    it.close()
+
+
+def test_async_close_no_leaked_threads():
+    x, y = _data(40)
+    # warm everything once so lazily-started runtime threads don't skew
+    # the baseline count
+    it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8))
+    list(it)
+    it.close()
+    n0 = threading.active_count()
+    for _ in range(10):
+        it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8))
+        it.next()         # consume mid-epoch, then abandon via close()
+        it.close()
+    assert _wait_threads(n0) <= n0
+
+
+def test_async_empty_source_does_not_hang():
+    # review regression: a source that is exhausted from the start (the
+    # drop_last zero-batch case) must report empty, not block in has_next
+    x, y = _data(3)
+    with pytest.warns(UserWarning):
+        src = ArrayDataSetIterator(x, y, batch_size=8, drop_last=True)
+    it = AsyncDataSetIterator(src)
+    assert not it.has_next()
+    with pytest.raises(StopIteration):
+        it.next()
+    it.close()
+
+
+def test_async_error_then_poll_does_not_hang():
+    # review regression: catching the propagated worker error and
+    # re-polling must see an exhausted iterator, not block forever
+    it = AsyncDataSetIterator(_FailingIterator(good=1))
+    it.next()
+    with pytest.raises(RuntimeError):
+        it.next()
+    assert not it.has_next()
+    with pytest.raises(StopIteration):
+        it.next()
+    it.close()
+
+
+def test_pad_to_batch_inferred_target_overflow_is_actionable():
+    x, y = _data(20)
+    dss = [DataSet(x[:4], y[:4]), DataSet(x[4:12], y[4:12])]
+    it = PadToBatchIterator(ExistingDataSetIterator(dss))  # batch() == -1
+    it.next()   # locks the inferred target to 4
+    with pytest.raises(ValueError, match="batch_size=.*explicitly"):
+        it.next()
+
+
+def test_async_close_then_reset_restarts():
+    x, y = _data(24)
+    it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8))
+    it.close()
+    assert not it.has_next()
+    it.reset()
+    assert sum(b.num_examples() for b in it) == 24
+    it.close()
+
+
+# ---------------------------------------------------------------------------
+# ArrayDataSetIterator satellites
+# ---------------------------------------------------------------------------
+
+def test_drop_last_smaller_than_batch_warns():
+    x, y = _data(3)
+    with pytest.warns(UserWarning, match="zero batches"):
+        it = ArrayDataSetIterator(x, y, batch_size=8, drop_last=True)
+    assert not it.has_next()
+
+
+def test_shuffle_first_epoch_uses_seed():
+    """Satellite regression: epoch E permutes with `seed + E` counting
+    CONSUMED epochs — the constructor's reset and fit()'s epoch-start
+    reset no longer burn a permutation, so the first epoch is
+    reproducible from `seed=` alone."""
+    n = 20
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    it = ArrayDataSetIterator(x, x, batch_size=n, shuffle=True, seed=5)
+    it.reset()   # fit()-style epoch-start reset before any consumption
+    got = it.next().features[:, 0].astype(int)
+    np.testing.assert_array_equal(
+        got, np.random.default_rng(5).permutation(n))
+    it.reset()   # an epoch was consumed -> epoch 1
+    got2 = it.next().features[:, 0].astype(int)
+    np.testing.assert_array_equal(
+        got2, np.random.default_rng(6).permutation(n))
