@@ -122,6 +122,41 @@ def bench_pipeline(devices=8):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_checkpoint(reps=5):
+    """Wall-clock ms for a crash-safe zip checkpoint save (atomic rename +
+    sha256 manifest) and verified restore_into of the LeNet bench model —
+    the per-checkpoint cost a `checkpoint_every=` cadence pays (ISSUE 5).
+    Median of `reps`, measured through the same fault/metrics timers the
+    fit paths use, so extras.telemetry.fault carries the aggregate too."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    model = lenet_mnist(seed=7)
+    if model.params is None:
+        model.init()
+    d = tempfile.mkdtemp(prefix="dl4j_ckpt_bench_")
+    try:
+        path = os.path.join(d, "ckpt.zip")
+        saves, restores = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ModelSerializer.write_model(model, path)
+            saves.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            ModelSerializer.restore_into(model, path)
+            restores.append((time.perf_counter() - t0) * 1e3)
+        saves.sort(), restores.sort()
+        nbytes = os.path.getsize(path)
+        return {"save": round(saves[len(saves) // 2], 2),
+                "restore": round(restores[len(restores) // 2], 2),
+                "zip_mb": round(nbytes / 1e6, 2)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _median_spread(fn, reps=3):
     """Median of `reps` in-process calls of a ()->float bench, plus the
     [min, max] spread (round-5 reporting contract)."""
@@ -214,6 +249,14 @@ def main():
                     "replicated_updater_cost_ms")
     except Exception:
         pass
+    try:
+        # checkpoint overhead (ISSUE 5): crash-safe zip save + verified
+        # restore of the LeNet bench model, so future PRs can cite the
+        # cost of a given checkpoint_every= cadence. The timers also land
+        # in extras.telemetry.fault via the registry.
+        extras["Checkpoint-zip-ms"] = bench_checkpoint()
+    except Exception as e:
+        extras["Checkpoint-zip-ms"] = f"error: {type(e).__name__}"
     try:
         pipe = bench_pipeline(8)
         if pipe:

@@ -226,6 +226,15 @@ class ArrayDataSetIterator(DataSetIterator):
         self._pos = 0
         self._drawn = False
 
+    def set_epoch(self, epoch: int):
+        """Position the shuffle-epoch counter (checkpoint resume): the
+        iterator reshuffles as if `epoch` epochs had already been
+        consumed, so a resumed fit replays the exact permutation the
+        interrupted run would have used (seed + epoch)."""
+        self._epoch = int(epoch)
+        self._drawn = False
+        self.reset()
+
     def has_next(self) -> bool:
         remaining = len(self._order) - self._pos
         if self.drop_last:

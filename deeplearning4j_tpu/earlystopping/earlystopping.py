@@ -49,7 +49,17 @@ class DataSetLossCalculator:
             n = ds.num_examples()
             total += model.score(ds) * n
             count += n
-        return total / count if (self.average and count) else total
+        if count == 0:
+            # an exhausted/empty validation iterator would silently score
+            # 0.0 (or NaN from 0/0) — and a bogus 0.0 "best score" makes
+            # early stopping save garbage as the best model. Fail loudly.
+            raise ValueError(
+                "DataSetLossCalculator: validation iterator yielded no "
+                "examples — the score would be meaningless (0/0). Check "
+                "that the iterator reset() works, is not already "
+                "exhausted, and that drop_last/batch_size leave at least "
+                "one batch")
+        return total / count if self.average else total
 
 
 # --------------------------- termination conditions ------------------------
@@ -319,20 +329,13 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
             self.trainer._fit_batch(ds)
 
     def _model_for_saving(self):
-        from ..parallel.trainer import TrainingMode
         tr = self.trainer
-        if tr._pipe is not None or tr.mode == TrainingMode.SYNC:
-            # publish the mesh params into the wrapped model, save that
+        if tr._pipe is not None:
+            # stage-partitioned params live in the pipe trainer; publish
             tr._sync_back()
             return tr.model
-        # AVERAGING: publish the averaged VIEW without collapsing the live
+        # non-destructive publish: SYNC rebinds the replicated trees;
+        # AVERAGING binds the averaged VIEW without collapsing the live
         # replicas (tr._sync_back would average them in place, perturbing
         # the local-SGD training that continues after the save)
-        import jax as _jax
-        tmap = _jax.tree_util.tree_map
-        params, state = tr._eval_params_state()
-        tr.model.params = params
-        tr.model.state = state
-        tr.model.updater_state = tmap(lambda a: a.mean(0), tr._opt)
-        tr.model.iteration_count = tr.iteration_count
-        return tr.model
+        return tr.publish_view()

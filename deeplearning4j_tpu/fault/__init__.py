@@ -1,0 +1,41 @@
+"""Fault tolerance: crash-safe checkpoints, auto-resume, training guards,
+and a deterministic fault-injection harness.
+
+The reference has *no* mid-job checkpoint/resume (Spark masters save
+nothing — SURVEY.md §5); production training treats frequent verified
+checkpoints as THE fault-tolerance primitive (Eisenman et al.,
+*Check-N-Run*, NSDI 2022). Four pieces:
+
+  * `atomic`    — temp-file + fsync + atomic-rename writes, sha256
+                  manifests, directory COMMIT markers. Used by
+                  `util/serializer.py` and `parallel/checkpoint.py`.
+  * `resume`    — `CheckpointManager` (retained, verified zip checkpoints)
+                  and `FitCheckpointer` (interval saves, resume
+                  bookkeeping, SIGTERM snapshot) behind the
+                  `checkpoint_dir= / checkpoint_every= / resume=` knobs on
+                  every fit path.
+  * `guard`     — `TrainingGuard`: isfinite check on every step's loss
+                  with warn/skip_batch/rollback/halt policies, plus
+                  bounded-backoff retry for transient iterator errors.
+  * `injection` — `FaultyIterator` + `crash_at_write` crash points, so
+                  every recovery path above is tested deterministically.
+
+Everything emits telemetry through the PR-2 registry
+(`dl4j_fault_nonfinite_steps_total`, `dl4j_fault_retries_total`,
+`dl4j_fault_rollbacks_total`, `dl4j_checkpoint_{save,restore}_seconds`).
+"""
+from .atomic import (COMMIT_MARKER, CorruptCheckpointError, atomic_replace,
+                     read_commit_marker, sha256_hex, write_commit_marker)
+from .guard import GuardPolicy, NonFiniteScoreError, TrainingGuard
+from .injection import FaultyIterator, SimulatedCrash, crash_at_write
+from .resume import (CheckpointManager, FitCheckpointer,
+                     maybe_fit_checkpointer, sharded_fit_checkpointer)
+
+__all__ = [
+    "COMMIT_MARKER", "CorruptCheckpointError", "atomic_replace",
+    "read_commit_marker", "sha256_hex", "write_commit_marker",
+    "GuardPolicy", "NonFiniteScoreError", "TrainingGuard",
+    "FaultyIterator", "SimulatedCrash", "crash_at_write",
+    "CheckpointManager", "FitCheckpointer", "maybe_fit_checkpointer",
+    "sharded_fit_checkpointer",
+]

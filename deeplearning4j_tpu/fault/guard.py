@@ -1,0 +1,229 @@
+"""TrainingGuard — non-finite-step detection + transient-error retry.
+
+One bad batch (a NaN in the data, an overflowing loss) silently poisons
+params forever: every subsequent step multiplies NaNs through the whole
+tree, and the first visible symptom is an evaluation that returns garbage
+hours later. The guard checks `isfinite(loss)` after every step — the loss
+is the one scalar the train step already returns, so the only added cost
+is the host sync that reads it (opt-in, like `collect_stats`) — and applies
+a policy:
+
+  warn        log + count; keep the (possibly poisoned) step.
+  skip_batch  restore the pre-batch snapshot (params/state/updater/rng/
+              counters) and continue — the offending batch simply never
+              happened. Costs one device-side copy of the model trees per
+              step (donation invalidates the originals).
+  rollback    restore the last *known-good* snapshot, refreshed every
+              `refresh_every` finite steps — reaches further back than
+              skip_batch for losses that go bad a few steps after the
+              params do.
+  halt        raise NonFiniteScoreError immediately.
+
+Plus `next_batch`: bounded exponential-backoff retry around
+`iterator.next()` for transient data-source errors (flaky network reader,
+NFS hiccup). SimulatedCrash/KeyboardInterrupt are BaseExceptions and are
+never retried.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+from . import metrics as _m
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["GuardPolicy", "NonFiniteScoreError", "TrainingGuard"]
+
+
+class GuardPolicy:
+    WARN = "warn"
+    SKIP_BATCH = "skip_batch"
+    ROLLBACK = "rollback"
+    HALT = "halt"
+
+    ALL = (WARN, SKIP_BATCH, ROLLBACK, HALT)
+
+
+class NonFiniteScoreError(RuntimeError):
+    """Loss went NaN/Inf under the `halt` policy (or the guard gave up
+    after `max_consecutive` non-finite steps in a row)."""
+
+
+def _copy_val(v):
+    """Deep copy for snapshot entries: jax pytrees get fresh device
+    buffers (the train step donates the originals); python scalars pass
+    through."""
+    if v is None or isinstance(v, (int, float, bool, str)):
+        return v
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), v)
+
+
+class TrainingGuard:
+    """Wraps the per-batch fit step of any model-like (MultiLayerNetwork,
+    ComputationGraph, ParallelTrainer — anything declaring
+    `_fault_state_attrs`) with non-finite detection + snapshot/restore.
+
+    Stateless across fits except the known-good snapshot and counters, so
+    one guard can follow a model through several `fit` calls.
+    """
+
+    def __init__(self, policy: str = GuardPolicy.WARN, *,
+                 refresh_every: int = 10, max_consecutive: int = 25,
+                 max_retries: int = 3, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0):
+        if policy not in GuardPolicy.ALL:
+            raise ValueError(f"unknown guard policy {policy!r}; choose from "
+                             f"{GuardPolicy.ALL}")
+        self.policy = policy
+        self.refresh_every = max(1, int(refresh_every))
+        self.max_consecutive = int(max_consecutive)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.nonfinite_steps = 0        # total seen (mirrors telemetry)
+        self.skipped_batches = 0
+        self._consecutive = 0
+        self._good_streak = 0
+        self._known_good = None
+
+    # ------------------------------------------------------------------
+    # snapshots
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _state_attrs(model):
+        attrs = getattr(model, "_fault_state_attrs", None)
+        if attrs is None:
+            raise TypeError(
+                f"{type(model).__name__} does not declare _fault_state_attrs"
+                " — TrainingGuard cannot snapshot it")
+        return attrs
+
+    def _snapshot(self, model):
+        return {a: _copy_val(getattr(model, a, None))
+                for a in self._state_attrs(model)}
+
+    def _restore(self, model, snap):
+        for a, v in snap.items():
+            setattr(model, a, _copy_val(v))
+
+    # ------------------------------------------------------------------
+    # per-batch stepping
+    # ------------------------------------------------------------------
+    @property
+    def _needs_snapshot(self) -> bool:
+        return self.policy in (GuardPolicy.SKIP_BATCH, GuardPolicy.ROLLBACK)
+
+    def run_step(self, model, step_fn) -> bool:
+        """Execute one training step (`step_fn()` mutates `model`) under
+        the guard. Returns True if the step was kept, False if it was
+        undone (skip_batch/rollback)."""
+        snap = self._snapshot(model) if self._needs_snapshot else None
+        if self.policy == GuardPolicy.ROLLBACK and self._known_good is None:
+            self._known_good = snap
+        step_fn()
+        import jax.numpy as jnp
+        score = float(jnp.asarray(model._score))
+        if math.isfinite(score):
+            self._consecutive = 0
+            self._good_streak += 1
+            if (self.policy == GuardPolicy.ROLLBACK
+                    and self._good_streak >= self.refresh_every):
+                self._known_good = self._snapshot(model)
+                self._good_streak = 0
+            return True
+        return self._handle_nonfinite(model, snap, score)
+
+    def check_scores(self, model, scores, snap) -> bool:
+        """Epoch-granular check for the scan paths: `scores` is the host
+        array of per-step losses the epoch dispatch produced, `snap` the
+        pre-epoch snapshot (or None for warn/halt). Returns True to keep
+        the epoch. Rollback works at epoch granularity here: the
+        known-good snapshot refreshes every `refresh_every` finite
+        EPOCHS, and a non-finite epoch with no known-good yet falls back
+        to the pre-epoch snapshot."""
+        import numpy as np
+        bad = int((~np.isfinite(np.asarray(scores, dtype=np.float64))).sum())
+        if bad == 0:
+            self._consecutive = 0
+            self._good_streak += 1
+            if (self.policy == GuardPolicy.ROLLBACK
+                    and self._good_streak >= self.refresh_every):
+                self._known_good = self._snapshot(model)
+                self._good_streak = 0
+            return True
+        if self.policy == GuardPolicy.ROLLBACK and self._known_good is None:
+            self._known_good = snap
+        return self._handle_nonfinite(model, snap, float("nan"), n=bad)
+
+    def _handle_nonfinite(self, model, snap, score, n: int = 1) -> bool:
+        self.nonfinite_steps += n
+        _m.count_nonfinite(self.policy, n)
+        self._consecutive += 1
+        self._good_streak = 0
+        if self._consecutive > self.max_consecutive:
+            raise NonFiniteScoreError(
+                f"{self._consecutive} consecutive non-finite training steps "
+                f"under policy={self.policy!r} — data or learning rate is "
+                "systematically bad, refusing to spin")
+        if self.policy == GuardPolicy.HALT:
+            raise NonFiniteScoreError(
+                f"training loss went non-finite ({score}) at iteration "
+                f"{getattr(model, 'iteration_count', '?')} (policy=halt)")
+        if self.policy == GuardPolicy.WARN:
+            log.warning(
+                "non-finite training loss (%s) at iteration %s kept under "
+                "policy=warn — params may now be poisoned; consider "
+                "skip_batch/rollback", score,
+                getattr(model, "iteration_count", "?"))
+            return True
+        if self.policy == GuardPolicy.SKIP_BATCH:
+            self._restore(model, snap)
+            self.skipped_batches += 1
+            _m.count_rollback(self.policy)
+            log.warning(
+                "non-finite training loss (%s) — batch skipped, state "
+                "restored to pre-batch snapshot (policy=skip_batch)", score)
+            return False
+        # ROLLBACK
+        self._restore(model, self._known_good)
+        self.skipped_batches += 1
+        _m.count_rollback(self.policy)
+        log.warning(
+            "non-finite training loss (%s) — rolled back to last known-good "
+            "snapshot at iteration %s (policy=rollback)", score,
+            getattr(model, "iteration_count", "?"))
+        return False
+
+    # ------------------------------------------------------------------
+    # transient-error retry around the data source
+    # ------------------------------------------------------------------
+    def next_batch(self, iterator):
+        """iterator.next() with bounded exponential-backoff retry on
+        transient errors. StopIteration propagates (not a fault);
+        BaseExceptions (SimulatedCrash, KeyboardInterrupt) are never
+        retried."""
+        attempt = 0
+        while True:
+            try:
+                return iterator.next()
+            except StopIteration:
+                raise
+            except Exception as e:
+                attempt += 1
+                _m.count_retry("iterator")
+                if attempt > self.max_retries:
+                    log.error(
+                        "data source still failing after %d retries: %s",
+                        self.max_retries, e)
+                    raise
+                delay = min(self.backoff_s * (2 ** (attempt - 1)),
+                            self.backoff_max_s)
+                log.warning(
+                    "transient data-source error (%s: %s) — retry %d/%d "
+                    "in %.3fs", type(e).__name__, e, attempt,
+                    self.max_retries, delay)
+                time.sleep(delay)

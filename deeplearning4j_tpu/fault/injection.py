@@ -1,0 +1,146 @@
+"""Deterministic fault injection for testing every recovery path.
+
+Two injection surfaces:
+
+  * **Crash points** — named hook points compiled into the durable-write
+    paths (`util/serializer.py`, `parallel/checkpoint.py`). A registered
+    hook fires at the point; `crash_at_write` installs one that raises
+    `SimulatedCrash` on the nth firing, so "the process died exactly
+    between writing the payload and committing it" is a reproducible test
+    case instead of a production incident. Points in use:
+      - ``zip/temp_written``    after the temp file's bytes are written,
+                                before fsync+atomic rename (ModelSerializer)
+      - ``sharded/tree_written`` after orbax wrote the step dir, before the
+                                COMMIT marker (ShardedCheckpoint.save)
+  * **FaultyIterator** — a DataSetIterator wrapper injecting data-plane
+    faults at exact global batch ordinals: transient/permanent raise,
+    all-NaN feature batches (numerically poisoned data), stalls.
+
+`SimulatedCrash` subclasses BaseException so it sails through the
+`except Exception` retry/cleanup layers the way SIGKILL would — only test
+harnesses catch it.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..datasets.iterators import DataSet, DataSetIterator
+
+__all__ = ["SimulatedCrash", "fire_crash_point", "crash_at_write",
+           "FaultyIterator"]
+
+
+class SimulatedCrash(BaseException):
+    """Injected mid-write process death (BaseException on purpose: generic
+    `except Exception` recovery code must not be able to swallow it)."""
+
+
+_crash_hooks: Dict[str, Callable] = {}
+
+
+def fire_crash_point(point: str, **info):
+    """Called by durable-write paths at their commit boundaries. No-op
+    (one dict lookup) unless a test installed a hook for `point`."""
+    cb = _crash_hooks.get(point)
+    if cb is not None:
+        cb(point, info)
+
+
+@contextlib.contextmanager
+def crash_at_write(point: str = "zip/temp_written", nth: int = 1):
+    """Install a crash hook: the `nth` firing of `point` raises
+    SimulatedCrash. Yields a dict whose "fired" entry counts firings, so
+    tests can assert the crash actually hit the intended write."""
+    state = {"fired": 0}
+
+    def cb(p, info):
+        state["fired"] += 1
+        if state["fired"] == nth:
+            raise SimulatedCrash(
+                f"injected crash at {p} (firing #{nth}; {info})")
+
+    prev = _crash_hooks.get(point)
+    _crash_hooks[point] = cb
+    try:
+        yield state
+    finally:
+        if prev is None:
+            _crash_hooks.pop(point, None)
+        else:
+            _crash_hooks[point] = prev
+
+
+class FaultyIterator(DataSetIterator):
+    """Wrap a DataSetIterator with faults at exact **global** batch
+    ordinals (0-based, counted across epochs — reset() does not reset the
+    ordinal, so "the 7th batch ever served" is deterministic even
+    mid-epoch-2).
+
+      raise_at    next() raises `exc` when about to serve this ordinal.
+      fail_times  how many consecutive next() calls fail there before the
+                  batch is served (transient fault; default 1).
+                  -1 = permanent (every call fails).
+      exc         exception factory/class (default: ``OSError`` with an
+                  "injected transient fault" message). Pass SimulatedCrash
+                  to model a hard process death (not retryable).
+      nan_at      serve this ordinal with all-NaN features (numerically
+                  poisoned batch — exercises TrainingGuard policies).
+      delay_at / delay_s   sleep before serving this ordinal (flaky/slow
+                  source; exercises timeout/backoff behavior).
+    """
+
+    def __init__(self, base: DataSetIterator, *, raise_at: Optional[int] = None,
+                 fail_times: int = 1, exc=None, nan_at: Optional[int] = None,
+                 delay_at: Optional[int] = None, delay_s: float = 0.0):
+        self.base = base
+        self.raise_at = raise_at
+        self.fail_times = fail_times
+        self.exc = exc if exc is not None else OSError
+        self.nan_at = nan_at
+        self.delay_at = delay_at
+        self.delay_s = float(delay_s)
+        self._served = 0      # global ordinal of the NEXT batch
+        self._failed = 0
+
+    # -- iterator contract ------------------------------------------------
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def next(self) -> DataSet:
+        i = self._served
+        if self.raise_at is not None and i == self.raise_at and (
+                self.fail_times < 0 or self._failed < self.fail_times):
+            self._failed += 1
+            exc = self.exc
+            raise (exc(f"injected transient fault at batch {i} "
+                       f"(attempt {self._failed})")
+                   if isinstance(exc, type) else exc)
+        if self.delay_at is not None and i == self.delay_at and self.delay_s:
+            time.sleep(self.delay_s)
+        ds = self.base.next()
+        self._served += 1
+        if self.nan_at is not None and i == self.nan_at:
+            feats = np.full_like(np.asarray(ds.features, np.float64), np.nan)
+            ds = DataSet(feats.astype(np.asarray(ds.features).dtype),
+                         ds.labels, ds.features_mask, ds.labels_mask)
+        return ds
+
+    def reset(self):
+        self.base.reset()
+
+    def batch(self) -> int:
+        return self.base.batch()
+
+    def set_epoch(self, epoch: int):
+        """Forward checkpoint-resume epoch positioning to the base."""
+        if hasattr(self.base, "set_epoch"):
+            self.base.set_epoch(epoch)
+
+    @property
+    def async_supported(self) -> bool:
+        # faults must fire on the consumer thread at deterministic points
+        return False

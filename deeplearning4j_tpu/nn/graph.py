@@ -32,6 +32,10 @@ __all__ = ["ComputationGraph"]
 
 
 class ComputationGraph:
+    # everything a training step mutates — TrainingGuard snapshot scope
+    _fault_state_attrs = ("params", "state", "updater_state", "_rng",
+                          "iteration_count", "epoch_count", "_score")
+
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.iteration_count = 0
@@ -468,27 +472,65 @@ class ComputationGraph:
     # Public API
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
-            pad_ragged: bool = False, time_buckets=None):
+            pad_ragged: bool = False, time_buckets=None,
+            checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
+            resume: bool = False, guard=None):
         """fit(DataSet/MultiDataSet) or fit(iterator). `pad_ragged` pads
         ragged final batches to the fixed batch size with weight-zero rows
         (one train-step compile per fit, learning no-op); `prefetch` moves
         `device_tuple()` to a background thread one batch ahead so
-        host->device transfer overlaps compute (see datasets/pipeline.py)."""
+        host->device transfer overlaps compute (see datasets/pipeline.py).
+
+        Fault-tolerance knobs (`checkpoint_dir`/`checkpoint_every`/
+        `resume`/`guard`) behave exactly as on `MultiLayerNetwork.fit`:
+        crash-safe interval checkpoints + SIGTERM snapshot, resume that
+        replays counters/RNG/shuffle epoch so it matches an uninterrupted
+        run, and a TrainingGuard applying its non-finite-loss policy per
+        batch (see fault/)."""
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
-            self._fit_batch(data)
+            if checkpoint_dir is not None or resume:
+                raise ValueError(
+                    "checkpoint_dir/resume need an iterator fit (the "
+                    "checkpoint records epoch/batch progress)")
+            if guard is not None:
+                guard.run_step(self, lambda: self._fit_batch(data))
+            else:
+                self._fit_batch(data)
             return self
+        from ..fault.resume import maybe_fit_checkpointer
+        ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
+                                      resume)
+        skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
+                   else _null_span())
         try:
-            for _ in range(epochs):
-                data.reset()
-                while data.has_next():
-                    self._fit_batch(data.next())
-                self.epoch_count += 1
+            with sigterm:
+                for _ in range(max(0, epochs - done_epochs)):
+                    data.reset()
+                    while data.has_next():
+                        ds = (guard.next_batch(data) if guard is not None
+                              else data.next())
+                        if skip:
+                            skip -= 1   # resume: prefix already trained
+                            continue
+                        if guard is not None:
+                            guard.run_step(self,
+                                           lambda b=ds: self._fit_batch(b))
+                        else:
+                            self._fit_batch(ds)
+                        if ckpt is not None:
+                            ckpt.on_batch()
+                    self.epoch_count += 1
+                    if ckpt is not None:
+                        ckpt.on_epoch()
+                if ckpt is not None:
+                    ckpt.on_fit_end()
         finally:
             close()
         return self

@@ -85,6 +85,12 @@ def _rescale_bias_updates(updates, scale):
 
 
 class MultiLayerNetwork:
+    # attrs a TrainingGuard snapshot/restore covers (fault/guard.py):
+    # everything a training step mutates, so a restored snapshot is
+    # indistinguishable from the step never having run
+    _fault_state_attrs = ("params", "state", "updater_state", "_rng",
+                          "iteration_count", "epoch_count", "_score")
+
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[LayerConf] = list(conf.layers)
@@ -397,7 +403,8 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, *,
             prefetch: bool = False, pad_ragged: bool = False,
-            time_buckets=None):
+            time_buckets=None, checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 0, resume: bool = False, guard=None):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels).
 
         Input-pipeline knobs (iterator inputs only; see
@@ -413,13 +420,38 @@ class MultiLayerNetwork:
           prefetch      — stage `device_tuple()` on a background thread one
                           batch ahead so host->device transfer overlaps the
                           previous step's compute (donation-safe: batch
-                          tensors are never donated)."""
+                          tensors are never donated).
+
+        Fault-tolerance knobs (iterator inputs; see `fault/`):
+          checkpoint_dir   — directory of crash-safe checkpoints (atomic
+                             zip writes with sha256 manifests). A SIGTERM
+                             during fit snapshots here before exit.
+          checkpoint_every — save every N iterations (0 = only at fit end
+                             and on SIGTERM).
+          resume           — restore the newest verifiable checkpoint
+                             first (params/updater/counters/RNG + the
+                             iterator's shuffle epoch via `set_epoch`),
+                             skip the already-trained prefix, and train
+                             only what remains of `epochs` — a resumed
+                             run matches an uninterrupted one.
+          guard            — a fault.TrainingGuard: isfinite check on
+                             every step's loss (warn/skip_batch/rollback/
+                             halt) + bounded-backoff retry around
+                             iterator.next() for transient data errors."""
         if self.params is None:
             self.init()
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         if isinstance(data, DataSet):
-            self._fit_batch(data)
+            if checkpoint_dir is not None or resume:
+                raise ValueError(
+                    "checkpoint_dir/resume need an iterator fit (the "
+                    "checkpoint records epoch/batch progress); wrap the "
+                    "DataSet in a ListDataSetIterator")
+            if guard is not None:
+                guard.run_step(self, lambda: self._fit_batch(data))
+            else:
+                self._fit_batch(data)
             return self
         if not isinstance(data, DataSetIterator):
             raise TypeError(f"Cannot fit on {type(data)}")
@@ -427,23 +459,55 @@ class MultiLayerNetwork:
             self.pretrain(data)
             self._pretrained = True
         if not self.conf.backprop:
+            if (checkpoint_dir is not None or resume or checkpoint_every
+                    or guard is not None):
+                raise ValueError(
+                    "checkpoint_dir/checkpoint_every/resume/guard need a "
+                    "backprop fit — this configuration has backprop=False, "
+                    "so none of them would take effect")
             return self
+        from ..fault.resume import maybe_fit_checkpointer
+        ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
+                                      resume)
+        skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
+                   else _null_span())
         try:
-            for _ in range(epochs):
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_start"):
-                        listener.on_epoch_start(self)
-                data.reset()
-                while data.has_next():
-                    self._fit_batch(data.next())
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
-                self.epoch_count += 1
+            with sigterm:
+                for _ in range(max(0, epochs - done_epochs)):
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_start"):
+                            listener.on_epoch_start(self)
+                    data.reset()
+                    while data.has_next():
+                        ds = (guard.next_batch(data) if guard is not None
+                              else data.next())
+                        if skip:
+                            # resume: this prefix of the epoch already
+                            # trained before the interruption — drawing
+                            # (and discarding) it keeps the iterator
+                            # position identical to the uninterrupted run
+                            skip -= 1
+                            continue
+                        if guard is not None:
+                            guard.run_step(self,
+                                           lambda b=ds: self._fit_batch(b))
+                        else:
+                            self._fit_batch(ds)
+                        if ckpt is not None:
+                            ckpt.on_batch()
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_end"):
+                            listener.on_epoch_end(self)
+                    self.epoch_count += 1
+                    if ckpt is not None:
+                        ckpt.on_epoch()
+                if ckpt is not None:
+                    ckpt.on_fit_end()
         finally:
             close()
         return self
@@ -451,7 +515,10 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Device-resident epoch training (one dispatch per epoch)
     # ------------------------------------------------------------------
-    def fit_scan(self, data, epochs: int = 1, *, pad_ragged: bool = False):
+    def fit_scan(self, data, epochs: int = 1, *, pad_ragged: bool = False,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0, resume: bool = False,
+                 guard=None):
         """Stack the dataset's batches into [T, ...] device arrays and
         `lax.scan` the train step — ONE device dispatch per epoch instead of
         one per batch. This matters whenever per-dispatch latency is
@@ -479,7 +546,10 @@ class MultiLayerNetwork:
                 data = ListDataSetIterator([data])
             elif not isinstance(data, DataSetIterator):
                 data = ListDataSetIterator(list(data))
-            return self.fit(data, epochs=epochs)
+            return self.fit(data, epochs=epochs,
+                            checkpoint_dir=checkpoint_dir,
+                            checkpoint_every=checkpoint_every,
+                            resume=resume, guard=guard)
         if isinstance(data, DataSet):
             batches = [data]
         elif isinstance(data, DataSetIterator):
@@ -519,10 +589,16 @@ class MultiLayerNetwork:
                             "features_mask")
         lmask = stack_masks([b.labels_mask for b in batches], "labels_mask")
 
-        return self.fit_scan_arrays(xs, ys, fmask, lmask, epochs=epochs)
+        return self.fit_scan_arrays(xs, ys, fmask, lmask, epochs=epochs,
+                                    checkpoint_dir=checkpoint_dir,
+                                    checkpoint_every=checkpoint_every,
+                                    resume=resume, guard=guard)
 
     def fit_scan_arrays(self, xs, ys, fmask=None, lmask=None,
-                        epochs: int = 1):
+                        epochs: int = 1, *,
+                        checkpoint_dir: Optional[str] = None,
+                        checkpoint_every: int = 0, resume: bool = False,
+                        guard=None):
         """fit_scan on pre-stacked [T, batch, ...] arrays. Pass
         device-resident arrays (jax.device_put once) to avoid re-paying the
         host->device transfer on every call — on remote-tunnel backends the
@@ -590,36 +666,67 @@ class MultiLayerNetwork:
         if self.listeners:
             from ..optimize.listeners import warn_scan_replay
             warn_scan_replay(self.listeners)
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            self._rng, k = jax.random.split(self._rng)
-            with span("device/dispatch", kind="scan_epoch"):
-                (self.params, self.state, self.updater_state,
-                 scores) = epoch_fn(
-                    self.params, self.state, self.updater_state,
-                    jnp.asarray(self.iteration_count, jnp.int32),
-                    xs_d, ys_d, fm_d, lm_d, fs_d,
-                    carries0 if tbptt else (), k)
-            self.last_batch_size = int(xs_d.shape[1])
-            self.last_input = xs_d[-1]   # last scanned batch, for listeners
-            n_steps = int(xs_d.shape[0])
-            if self.listeners:
-                with span("device/sync", kind="scan_scores"):
-                    host_scores = np.asarray(scores)
-                for i in range(n_steps):
-                    self._score = host_scores[i]
-                    self.iteration_count += 1
-                    for listener in self.listeners:
-                        listener.iteration_done(self, self.iteration_count)
-            else:
-                self._score = scores[-1]
-                self.iteration_count += n_steps
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch_count += 1
+        from ..fault.resume import maybe_fit_checkpointer
+        ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
+                                      resume)
+        done_epochs = (ckpt.resume_into()[1] if ckpt is not None else 0)
+        with (ckpt.sigterm_snapshot() if ckpt is not None else _null_span()):
+            for _ in range(max(0, epochs - done_epochs)):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                # guard works at EPOCH granularity here (the whole epoch is
+                # one dispatch): snapshot pre-epoch state (incl. rng) so a
+                # non-finite epoch can be discarded wholesale
+                snap = (guard._snapshot(self)
+                        if guard is not None and guard._needs_snapshot
+                        else None)
+                self._rng, k = jax.random.split(self._rng)
+                with span("device/dispatch", kind="scan_epoch"):
+                    (self.params, self.state, self.updater_state,
+                     scores) = epoch_fn(
+                        self.params, self.state, self.updater_state,
+                        jnp.asarray(self.iteration_count, jnp.int32),
+                        xs_d, ys_d, fm_d, lm_d, fs_d,
+                        carries0 if tbptt else (), k)
+                guard_scores = None
+                if guard is not None:
+                    with span("device/sync", kind="guard_scores"):
+                        guard_scores = np.asarray(scores)
+                    if not guard.check_scores(self, guard_scores, snap):
+                        # epoch discarded, pre-epoch state back — still
+                        # balance on_epoch_start with on_epoch_end
+                        for listener in self.listeners:
+                            if hasattr(listener, "on_epoch_end"):
+                                listener.on_epoch_end(self)
+                        continue
+                self.last_batch_size = int(xs_d.shape[1])
+                self.last_input = xs_d[-1]   # last scanned batch (listeners)
+                n_steps = int(xs_d.shape[0])
+                if self.listeners:
+                    if guard_scores is not None:
+                        host_scores = guard_scores   # already synced
+                    else:
+                        with span("device/sync", kind="scan_scores"):
+                            host_scores = np.asarray(scores)
+                    for i in range(n_steps):
+                        self._score = host_scores[i]
+                        self.iteration_count += 1
+                        for listener in self.listeners:
+                            listener.iteration_done(self,
+                                                    self.iteration_count)
+                else:
+                    self._score = scores[-1]
+                    self.iteration_count += n_steps
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch_count += 1
+                if ckpt is not None:
+                    ckpt.on_epoch()
+                    ckpt.maybe_save()
+            if ckpt is not None:
+                ckpt.on_fit_end()
         return self
 
     def _make_scan_epoch(self, has_fmask, has_lmask, tbptt):

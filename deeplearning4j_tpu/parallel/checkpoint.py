@@ -6,16 +6,34 @@ with an orbax-backed sharded checkpoint for meshes: each host writes only its
 param shards; restore places shards directly onto the target mesh without
 materializing the full tree on one host. This is capability the reference
 lacks (Spark masters save nothing mid-job — SURVEY.md §5 checkpoint/resume).
+
+Durability (fault/): each `step_NNNNNNNNN` directory commits via a COMMIT
+marker written *last* (itself an atomic rename) — a crash mid-save leaves a
+marker-less directory that `latest_step` skips and `_gc` sweeps, so
+`restore_latest` always lands on the last step whose save fully returned,
+falling further back if a committed step still fails to load (disk-level
+corruption). Retention keeps the newest `keep` committed steps plus the
+best-scoring one.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Optional
+import re
+from typing import Any, Dict, List, Optional
 
 import jax
 
+from ..fault.atomic import (read_commit_marker, write_commit_marker)
+from ..fault.injection import fire_crash_point
+from ..fault.metrics import checkpoint_timer
+
+log = logging.getLogger("deeplearning4j_tpu")
+
 __all__ = ["save_sharded", "restore_sharded", "ShardedCheckpoint"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 def _checkpointer():
@@ -30,17 +48,22 @@ def save_sharded(path: str, model, extra: Optional[dict] = None):
     os.makedirs(path, exist_ok=True)
     tree = {"params": model.params, "state": model.state,
             "updater_state": model.updater_state}
-    _checkpointer().save(os.path.join(path, "tree"), tree, force=True)
-    meta = {"kind": type(model).__name__,
-            "iteration_count": model.iteration_count,
-            "epoch_count": getattr(model, "epoch_count", 0)}
-    if extra:
-        meta.update(extra)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "config.json"), "w") as f:
-            f.write(model.conf.to_json())
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
+    with checkpoint_timer("save", "sharded"):
+        _checkpointer().save(os.path.join(path, "tree"), tree, force=True)
+        meta = {"kind": type(model).__name__,
+                "iteration_count": model.iteration_count,
+                "epoch_count": getattr(model, "epoch_count", 0)}
+        rng = getattr(model, "_rng", None)
+        if rng is not None:
+            import numpy as np
+            meta["rng_key"] = np.asarray(rng).tolist()
+        if extra:
+            meta.update(extra)
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "config.json"), "w") as f:
+                f.write(model.conf.to_json())
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
 
 
 def restore_sharded(path: str, model, shardings: Optional[Any] = None):
@@ -59,48 +82,151 @@ def restore_sharded(path: str, model, shardings: Optional[Any] = None):
     kwargs = {}
     if restore_args is not None:
         kwargs["restore_args"] = restore_args
-    restored = _checkpointer().restore(os.path.join(path, "tree"),
-                                       item=tree, **kwargs)
-    model.params = restored["params"]
-    model.state = restored["state"]
-    model.updater_state = restored["updater_state"]
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    model.iteration_count = meta.get("iteration_count", 0)
-    model.epoch_count = meta.get("epoch_count", 0)
+    with checkpoint_timer("restore", "sharded"):
+        restored = _checkpointer().restore(os.path.join(path, "tree"),
+                                           item=tree, **kwargs)
+        model.params = restored["params"]
+        model.state = restored["state"]
+        model.updater_state = restored["updater_state"]
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        model.iteration_count = meta.get("iteration_count", 0)
+        model.epoch_count = meta.get("epoch_count", 0)
+        rng = meta.get("rng_key")
+        if rng is not None and getattr(model, "_rng", None) is not None:
+            import jax.numpy as jnp
+            import numpy as np
+            model._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
     return model
 
 
 class ShardedCheckpoint:
-    """Thin OO wrapper (save/restore/latest) for training loops."""
+    """Step-directory checkpoint manager with commit markers, verified
+    retention (newest `keep` + best score) and corrupt-step fallback."""
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3,
+                 keep_best: bool = True):
         self.directory = os.path.abspath(directory)
-        self.keep = keep
+        self.keep = max(1, int(keep))
+        self.keep_best = bool(keep_best)
+        # steps THIS manager attempted to save: an uncommitted one of
+        # these is a crashed save and safe to sweep. Marker-less dirs we
+        # did not write may be a pre-COMMIT-marker layout — never deleted
+        self._attempted: set = set()
         os.makedirs(self.directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:09d}")
 
-    def save(self, model, step: int):
-        save_sharded(self._step_dir(step), model)
-        self._gc()
+    # ------------------------------------------------------------------
+    def _all_steps(self) -> List[int]:
+        """Every step-shaped entry, committed or not — parsed defensively:
+        `step_tmp`, stray files and foreign names are ignored instead of
+        crashing int() (regression: `int(d.split("_")[1])`)."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def steps(self) -> List[int]:
+        """Committed steps only, ascending."""
+        return [s for s in self._all_steps()
+                if read_commit_marker(self._step_dir(s)) is not None]
+
+    # ------------------------------------------------------------------
+    def save(self, model, step: int, score: Optional[float] = None,
+             extra: Optional[dict] = None):
+        """Save + commit one step. The `sharded/tree_written` crash point
+        fires between the payload write and the COMMIT marker: a crash
+        there leaves an uncommitted directory that readers skip."""
+        d = self._step_dir(step)
+        self._attempted.add(int(step))
+        save_sharded(d, model, extra=extra)
+        fire_crash_point("sharded/tree_written", path=d, step=step)
+        # process 0 writes meta.json/config.json in save_sharded, so only
+        # it may declare the step committed (a marker from another process
+        # could land before — or without — the metadata existing) or GC
+        if jax.process_index() == 0:
+            commit = {"step": int(step)}
+            if score is not None:
+                commit["score"] = float(score)
+            write_commit_marker(d, commit)
+            self._gc()
 
     def latest_step(self) -> Optional[int]:
-        steps = [int(d.split("_")[1]) for d in os.listdir(self.directory)
-                 if d.startswith("step_")]
-        return max(steps) if steps else None
+        """Newest **committed** step — a directory whose save died before
+        its COMMIT marker is not a checkpoint."""
+        steps = self.steps()
+        return steps[-1] if steps else None
 
-    def restore_latest(self, model, shardings=None):
-        s = self.latest_step()
-        if s is None:
+    def best_step(self) -> Optional[int]:
+        """Committed step with the best (lowest) recorded score, if any
+        save recorded one."""
+        best = None
+        for s in self.steps():
+            marker = read_commit_marker(self._step_dir(s)) or {}
+            score = marker.get("score")
+            if score is not None and (best is None or score < best[0]):
+                best = (score, s)
+        return best[1] if best else None
+
+    def meta(self, step: int) -> Optional[Dict]:
+        """The meta.json of a step (iteration/epoch/rng + extras)."""
+        try:
+            with open(os.path.join(self._step_dir(step), "meta.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
             return None
-        restore_sharded(self._step_dir(s), model, shardings)
-        return s
+
+    def restore_latest(self, model, shardings=None) -> Optional[int]:
+        """Restore the newest committed step; if a committed step fails to
+        load (disk corruption under the marker), fall back to the next
+        older one. When NO step carries a COMMIT marker at all — a
+        directory written by the pre-marker layout — fall back to trying
+        marker-less dirs newest-first (a half-written one simply fails to
+        load and the next older is tried). Returns the restored step, or
+        None."""
+        committed = self.steps()
+        candidates = committed
+        if not committed:
+            candidates = self._all_steps()
+            if candidates:
+                log.warning(
+                    "no COMMIT-marked steps under %s — pre-marker layout "
+                    "(or only crashed saves); attempting marker-less step "
+                    "dirs newest-first", self.directory)
+        for s in reversed(candidates):
+            try:
+                restore_sharded(self._step_dir(s), model, shardings)
+                return s
+            except Exception as e:
+                log.warning(
+                    "sharded checkpoint step %d unusable (%s: %s) — "
+                    "falling back to an older step", s,
+                    type(e).__name__, e)
+        return None
 
     def _gc(self):
-        steps = sorted([int(d.split("_")[1]) for d in os.listdir(self.directory)
-                        if d.startswith("step_")])
+        """Retention: newest `keep` committed steps + the best-scoring
+        one. Marker-less directories are swept ONLY if this manager wrote
+        them (a crashed save of ours, superseded by a newer commit) —
+        foreign marker-less dirs may be a pre-COMMIT-marker layout and
+        are left alone."""
         import shutil
-        for s in steps[:-self.keep]:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+        committed = self.steps()
+        keep = set(committed[-self.keep:])
+        if self.keep_best:
+            b = self.best_step()
+            if b is not None:
+                keep.add(b)
+        for s in committed:
+            if s not in keep:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        newest = committed[-1] if committed else None
+        for s in self._all_steps():
+            if (s not in committed and s in self._attempted
+                    and newest is not None and s < newest):
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)

@@ -63,6 +63,11 @@ class ParallelTrainer:
       prefetch_buffer (host-side async iterator wrapping).
     """
 
+    # TrainingGuard snapshot scope: the mesh-resident trees + counters the
+    # sharded step mutates (fault/guard.py)
+    _fault_state_attrs = ("_params", "_state", "_opt", "_rng",
+                          "iteration_count", "_score")
+
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: str = TrainingMode.SYNC,
                  strategy: str = ShardingStrategy.REPLICATED,
@@ -217,32 +222,78 @@ class ParallelTrainer:
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
-            pad_ragged: bool = False, time_buckets=None):
+            pad_ragged: bool = False, time_buckets=None,
+            checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
+            resume: bool = False, guard=None):
         """`pad_ragged` pads ragged final batches up to the fixed batch
         size with weight-zero mask rows (the same `_pad_to` zero-fill, made
         a learning no-op by mask-normalized loss/regularization) — every
         example trains instead of the remainder being dropped, and the
         sharded step keeps ONE signature. `prefetch` stages
         `device_tuple()` one batch ahead on a background thread (see
-        datasets/pipeline.py)."""
+        datasets/pipeline.py).
+
+        Fault-tolerance knobs mirror `MultiLayerNetwork.fit`, backed by
+        the **sharded** store (`parallel/checkpoint.py`): step dirs with
+        COMMIT markers, resume restores params/updater/counters/trainer
+        RNG and re-places them on the mesh. AVERAGING-mode saves record
+        the averaged replica view, so a resume restores that average to
+        every replica (per-replica local-SGD divergence inside the current
+        averaging window is not persisted). `guard` applies its
+        non-finite-loss policy to the mesh-wide step score."""
         if self._pipe is not None:
+            if checkpoint_dir is not None or resume or guard is not None:
+                raise ValueError(
+                    "checkpoint/resume/guard are not supported for the "
+                    "PIPELINE strategy (stage-partitioned params live in "
+                    "the pipe trainer); checkpoint the wrapped model via "
+                    "ModelSerializer after fit instead")
             self._pipe.fit(data, epochs=epochs)
             self.iteration_count = self._pipe.iteration_count
             self._pipe.sync_back()
             return self
         if isinstance(data, (DataSet, MultiDataSet)):
-            self._fit_batch(data)
+            if checkpoint_dir is not None or resume:
+                raise ValueError(
+                    "checkpoint_dir/resume need an iterator fit (the "
+                    "checkpoint records epoch/batch progress)")
+            if guard is not None:
+                guard.run_step(self, lambda: self._fit_batch(data))
+            else:
+                self._fit_batch(data)
             self._sync_back()
             return self
+        from ..fault.resume import sharded_fit_checkpointer
+        ckpt = sharded_fit_checkpointer(self, checkpoint_dir,
+                                        checkpoint_every, resume)
+        skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
+                   else _null_span())
         try:
-            for _ in range(epochs):
-                data.reset()
-                while data.has_next():
-                    self._fit_batch(data.next())
+            with sigterm:
+                for _ in range(max(0, epochs - done_epochs)):
+                    data.reset()
+                    while data.has_next():
+                        ds = (guard.next_batch(data) if guard is not None
+                              else data.next())
+                        if skip:
+                            skip -= 1   # resume: prefix already trained
+                            continue
+                        if guard is not None:
+                            guard.run_step(self,
+                                           lambda b=ds: self._fit_batch(b))
+                        else:
+                            self._fit_batch(ds)
+                        if ckpt is not None:
+                            ckpt.on_batch()
+                    if ckpt is not None:
+                        ckpt.on_epoch()
+                if ckpt is not None:
+                    ckpt.on_fit_end()
         finally:
             close()
         self._sync_back()
@@ -721,6 +772,25 @@ class ParallelTrainer:
         x, y, fm, lm = self._to_batch(ds)
         return np.asarray(self.model._score_examples_fn(
             params, state, x, y, fm, lm, bool(add_reg)))
+
+    def publish_view(self):
+        """Bind the current mesh params into the wrapped model WITHOUT
+        perturbing training state (unlike `_sync_back`, which in AVERAGING
+        mode collapses the live replicas to their mean, destroying the
+        local-SGD window). Used by checkpointing and best-model saving;
+        returns the wrapped model."""
+        if self.mode == TrainingMode.SYNC:
+            self.model.params = self._params
+            self.model.state = self._state
+            self.model.updater_state = self._opt
+        else:
+            tmap = jax.tree_util.tree_map
+            params, state = self._eval_params_state()
+            self.model.params = params
+            self.model.state = state
+            self.model.updater_state = tmap(lambda a: a.mean(0), self._opt)
+        self.model.iteration_count = self.iteration_count
+        return self.model
 
     def _sync_back(self):
         """Write averaged/replicated params back into the wrapped model."""

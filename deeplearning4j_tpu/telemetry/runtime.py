@@ -143,6 +143,28 @@ class TelemetrySession:
                                   for k, v in sorted(buckets.values().items())}
         return out
 
+    def fault_summary(self) -> Dict:
+        """Fault-tolerance metrics (fault/): checkpoint save/restore
+        counts + wall seconds per kind (zip|sharded), non-finite steps
+        seen, data-source retries and guard rollbacks. Empty dict when no
+        fault-path code ran under this session."""
+        out: Dict = {}
+        for op in ("save", "restore"):
+            t = self.registry.get(f"dl4j_checkpoint_{op}_seconds")
+            if t is not None and t.sums():
+                out[f"checkpoint_{op}s"] = {
+                    k[0]: t.count(kind=k[0]) for k in sorted(t.sums())}
+                out[f"checkpoint_{op}_s"] = {
+                    k[0]: round(v, 4) for k, v in sorted(t.sums().items())}
+        for name, key in (
+                ("dl4j_fault_nonfinite_steps_total", "nonfinite_steps"),
+                ("dl4j_fault_retries_total", "retries"),
+                ("dl4j_fault_rollbacks_total", "rollbacks")):
+            c = self.registry.get(name)
+            if c is not None and c.values():
+                out[key] = int(sum(c.values().values()))
+        return out
+
     def summary(self) -> Dict:
         """The compact dict bench.py embeds as extras.telemetry."""
         rep = self.compiles.report()
@@ -160,6 +182,9 @@ class TelemetrySession:
         pipe = self.pipeline_summary()
         if pipe:
             out["pipeline"] = pipe
+        fault = self.fault_summary()
+        if fault:
+            out["fault"] = fault
         return out
 
 

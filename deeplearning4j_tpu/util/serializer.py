@@ -13,6 +13,14 @@ reference (`writeModel` :52/79, zip entries :91-115). Arrays are written via
 `numpy.savez` with flattened tree paths as keys; restore rebuilds the exact
 pytrees. Sharded/distributed checkpointing lives in `parallel/checkpoint.py`
 (orbax-backed); this writer is the single-host format.
+
+Durability: `write_model` is **crash-safe** — the zip is assembled in
+memory and lands via temp-file + fsync + atomic rename (fault/atomic.py),
+so a crash at any point leaves the destination either absent or holding
+its previous complete contents, never a torn zip. A `manifest.sha256.json`
+entry records the sha256 of every other entry; every restore verifies it
+(CorruptCheckpointError on mismatch), so bit rot or a truncated copy is
+caught at load time instead of surfacing as silently-wrong params.
 """
 from __future__ import annotations
 
@@ -80,12 +88,17 @@ class ModelSerializer:
     UPDATER_STATE = "updaterState.npz"
     NETWORK_STATE = "networkState.npz"
     METADATA = "metadata.json"
+    MANIFEST = "manifest.sha256.json"
 
     # ------------------------------------------------------------------
     @staticmethod
-    def write_model(model, path: str, save_updater: bool = True):
-        """Write a MultiLayerNetwork or ComputationGraph to a zip file."""
-        from ..nn.multilayer import MultiLayerNetwork
+    def write_model(model, path: str, save_updater: bool = True,
+                    extra_meta: Optional[Dict] = None):
+        """Write a MultiLayerNetwork or ComputationGraph to a zip file —
+        crash-safely (temp + fsync + atomic rename) with a sha256 manifest
+        of every entry. `extra_meta` merges into metadata.json (checkpoint
+        bookkeeping: score, epoch-in-fit, ...)."""
+        from ..fault.metrics import checkpoint_timer
 
         kind = type(model).__name__
         meta = {
@@ -94,16 +107,73 @@ class ModelSerializer:
             "epoch_count": getattr(model, "epoch_count", 0),
             "format_version": 1,
         }
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr(ModelSerializer.CONFIG, model.conf.to_json())
-            z.writestr(ModelSerializer.COEFFICIENTS,
-                       _savez(tree_to_arrays(model.params)))
-            z.writestr(ModelSerializer.NETWORK_STATE,
-                       _savez(tree_to_arrays(model.state)))
-            if save_updater and model.updater_state is not None:
-                z.writestr(ModelSerializer.UPDATER_STATE,
-                           _savez(tree_to_arrays(model.updater_state)))
-            z.writestr(ModelSerializer.METADATA, json.dumps(meta))
+        rng = getattr(model, "_rng", None)
+        if rng is not None:
+            # the PRNG key makes resume bit-exact: the resumed fit replays
+            # the same per-batch split sequence (dropout, shuffles)
+            meta["rng_key"] = np.asarray(rng).tolist()
+        if extra_meta:
+            meta.update(extra_meta)
+        entries = [(ModelSerializer.CONFIG, model.conf.to_json().encode()),
+                   (ModelSerializer.COEFFICIENTS,
+                    _savez(tree_to_arrays(model.params))),
+                   (ModelSerializer.NETWORK_STATE,
+                    _savez(tree_to_arrays(model.state)))]
+        if save_updater and model.updater_state is not None:
+            entries.append((ModelSerializer.UPDATER_STATE,
+                            _savez(tree_to_arrays(model.updater_state))))
+        entries.append((ModelSerializer.METADATA, json.dumps(meta).encode()))
+        with checkpoint_timer("save", "zip"):
+            ModelSerializer._write_zip_atomic(path, entries)
+
+    @staticmethod
+    def _write_zip_atomic(path: str, entries):
+        """Assemble the zip (+ manifest entry) in memory, then commit it
+        with one atomic rename. The `zip/temp_written` crash point fires
+        between the temp write and the rename (fault/injection.py)."""
+        from ..fault.atomic import atomic_replace, sha256_hex
+
+        manifest = {"sha256": {name: sha256_hex(data)
+                               for name, data in entries},
+                    "format_version": 1}
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for name, data in entries:
+                z.writestr(name, data)
+            z.writestr(ModelSerializer.MANIFEST, json.dumps(manifest))
+        atomic_replace(path, buf.getvalue(), crash_point="zip/temp_written")
+
+    @staticmethod
+    def verify(path: str):
+        """Check every entry against the sha256 manifest; raises
+        CorruptCheckpointError on mismatch. Pre-manifest zips (older
+        writers) pass — there is nothing to verify against."""
+        with zipfile.ZipFile(path) as z:
+            ModelSerializer._read_verified(z, path)
+
+    @staticmethod
+    def _read_verified(z: zipfile.ZipFile, path: str) -> Dict[str, bytes]:
+        """Read every entry ONCE, verify against the manifest, and return
+        {name: bytes} — restore then consumes the verified bytes instead
+        of inflating each entry a second time."""
+        from ..fault.atomic import CorruptCheckpointError, sha256_hex
+
+        entries = {n: z.read(n) for n in z.namelist()}
+        raw = entries.pop(ModelSerializer.MANIFEST, None)
+        if raw is None:
+            return entries
+        want = json.loads(raw.decode()).get("sha256", {})
+        missing = set(want) - set(entries)
+        if missing:
+            raise CorruptCheckpointError(
+                f"{path}: manifest lists entries missing from the zip: "
+                f"{sorted(missing)}")
+        for name in sorted(entries):
+            if name in want and sha256_hex(entries[name]) != want[name]:
+                raise CorruptCheckpointError(
+                    f"{path}: sha256 mismatch for entry '{name}' — "
+                    "checkpoint is corrupt (torn copy or bit rot)")
+        return entries
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -112,11 +182,12 @@ class ModelSerializer:
         from ..nn.multilayer import MultiLayerNetwork
 
         with zipfile.ZipFile(path) as z:
-            conf = MultiLayerConfiguration.from_json(
-                z.read(ModelSerializer.CONFIG).decode())
-            model = MultiLayerNetwork(conf)
-            model.init()
-            ModelSerializer._restore_into(model, z, load_updater)
+            entries = ModelSerializer._read_verified(z, path)
+        conf = MultiLayerConfiguration.from_json(
+            entries[ModelSerializer.CONFIG].decode())
+        model = MultiLayerNetwork(conf)
+        model.init()
+        ModelSerializer._restore_into(model, entries, load_updater)
         return model
 
     @staticmethod
@@ -129,26 +200,47 @@ class ModelSerializer:
                 "ComputationGraph support is not available in this build") from e
 
         with zipfile.ZipFile(path) as z:
-            conf = ComputationGraphConfiguration.from_json(
-                z.read(ModelSerializer.CONFIG).decode())
-            model = ComputationGraph(conf)
-            model.init()
-            ModelSerializer._restore_into(model, z, load_updater)
+            entries = ModelSerializer._read_verified(z, path)
+        conf = ComputationGraphConfiguration.from_json(
+            entries[ModelSerializer.CONFIG].decode())
+        model = ComputationGraph(conf)
+        model.init()
+        ModelSerializer._restore_into(model, entries, load_updater)
         return model
 
     @staticmethod
-    def _restore_into(model, z: zipfile.ZipFile, load_updater: bool):
-        meta = json.loads(z.read(ModelSerializer.METADATA).decode())
-        model.params = arrays_to_tree(model.params,
-                                      _loadz(z.read(ModelSerializer.COEFFICIENTS)))
-        if ModelSerializer.NETWORK_STATE in z.namelist():
-            model.state = arrays_to_tree(model.state,
-                                         _loadz(z.read(ModelSerializer.NETWORK_STATE)))
-        if load_updater and ModelSerializer.UPDATER_STATE in z.namelist():
+    def restore_into(model, path: str, load_updater: bool = True) -> Dict:
+        """Restore a checkpoint **into an already-initialized model** of
+        the same architecture (the auto-resume path: no config re-parse,
+        no re-init). Verifies the manifest first. Returns the metadata
+        dict (iteration/epoch counters, checkpoint extras)."""
+        from ..fault.metrics import checkpoint_timer
+
+        with checkpoint_timer("restore", "zip"):
+            with zipfile.ZipFile(path) as z:
+                entries = ModelSerializer._read_verified(z, path)
+            return ModelSerializer._restore_into(model, entries, load_updater)
+
+    @staticmethod
+    def _restore_into(model, entries: Dict[str, bytes],
+                      load_updater: bool) -> Dict:
+        meta = json.loads(entries[ModelSerializer.METADATA].decode())
+        model.params = arrays_to_tree(
+            model.params, _loadz(entries[ModelSerializer.COEFFICIENTS]))
+        if ModelSerializer.NETWORK_STATE in entries:
+            model.state = arrays_to_tree(
+                model.state, _loadz(entries[ModelSerializer.NETWORK_STATE]))
+        if load_updater and ModelSerializer.UPDATER_STATE in entries:
             model.updater_state = arrays_to_tree(
-                model.updater_state, _loadz(z.read(ModelSerializer.UPDATER_STATE)))
+                model.updater_state,
+                _loadz(entries[ModelSerializer.UPDATER_STATE]))
         model.iteration_count = meta.get("iteration_count", 0)
         model.epoch_count = meta.get("epoch_count", 0)
+        rng = meta.get("rng_key")
+        if rng is not None and getattr(model, "_rng", None) is not None:
+            import jax.numpy as jnp
+            model._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+        return meta
 
     # ------------------------------------------------------------------
     @staticmethod

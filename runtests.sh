@@ -8,8 +8,15 @@
 #   ./runtests.sh pipeline   input-pipeline smoke only (PadToBatch /
 #                            DevicePrefetch, ragged-batch compile counts,
 #                            async iterator lifecycle)
+#   ./runtests.sh fault      fault-tolerance smoke only (crash-safe
+#                            checkpoints, kill-mid-save recovery, resume
+#                            equivalence, TrainingGuard policies)
 set -euo pipefail
 cd "$(dirname "$0")"
+if [[ "${1:-}" == "fault" ]]; then
+    echo "=== fault-tolerance smoke ==="
+    exec python -m pytest tests/test_fault.py -q
+fi
 if [[ "${1:-}" == "telemetry" ]]; then
     echo "=== telemetry smoke ==="
     exec python -m pytest tests/test_telemetry.py -q

@@ -1,0 +1,639 @@
+"""Fault-tolerance suite (ISSUE 5): crash-safe checkpoints, auto-resume,
+TrainingGuard policies, and the deterministic fault-injection harness.
+
+The two acceptance scenarios live here:
+  * a fit killed mid-checkpoint-write (injected SimulatedCrash at the
+    commit boundary) resumes from the last committed step and reaches
+    params matching an uninterrupted run to tolerance — for the zip
+    (MultiLayerNetwork/ComputationGraph), scan, and sharded
+    (ParallelTrainer) stores;
+  * an injected NaN batch under policy=skip_batch is skipped, counted in
+    telemetry, and training still converges.
+"""
+import json
+import os
+import signal
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, ComputationGraph,
+                                DataSet, DenseLayer, InputType,
+                                ModelSerializer, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer,
+                                telemetry)
+from deeplearning4j_tpu.fault import (CheckpointManager,
+                                      CorruptCheckpointError, FaultyIterator,
+                                      FitCheckpointer, NonFiniteScoreError,
+                                      SimulatedCrash, TrainingGuard,
+                                      atomic_replace, crash_at_write)
+from deeplearning4j_tpu.fault.resume import _ZipModelStore
+from deeplearning4j_tpu.parallel import ParallelTrainer
+from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpoint
+
+from conftest import make_classification
+
+
+def _model(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(10))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+XS, YS = make_classification(n=96, seed=3)
+
+
+def _iter(batch=16, xs=None, ys=None):
+    return ArrayDataSetIterator(XS if xs is None else xs,
+                                YS if ys is None else ys,
+                                batch_size=batch, shuffle=True, seed=7)
+
+
+def _params(m):
+    return np.asarray(m.params_flat())
+
+
+# ======================================================================
+# atomic writes + manifests
+# ======================================================================
+
+def test_atomic_replace_crash_preserves_previous(tmp_path):
+    p = str(tmp_path / "f.bin")
+    atomic_replace(p, b"version-1", crash_point="t/point")
+    with crash_at_write("t/point") as st:
+        with pytest.raises(SimulatedCrash):
+            atomic_replace(p, b"version-2", crash_point="t/point")
+    assert st["fired"] == 1
+    with open(p, "rb") as f:
+        assert f.read() == b"version-1"
+
+
+def test_write_model_crash_preserves_previous_zip(tmp_path):
+    path = str(tmp_path / "model.zip")
+    m1 = _model(seed=1)
+    m1.fit(DataSet(XS[:16], YS[:16]))
+    ModelSerializer.write_model(m1, path)
+    m2 = _model(seed=2)
+    with crash_at_write("zip/temp_written"):
+        with pytest.raises(SimulatedCrash):
+            ModelSerializer.write_model(m2, path)
+    # the previous complete checkpoint survived, verifies, and restores
+    ModelSerializer.verify(path)
+    back = ModelSerializer.restore(path)
+    np.testing.assert_array_equal(_params(back), _params(m1))
+
+
+def test_manifest_detects_corruption(tmp_path):
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.write_model(_model(), path)
+    # corrupt one payload entry, keep the manifest
+    with zipfile.ZipFile(path) as z:
+        entries = {n: z.read(n) for n in z.namelist()}
+    entries[ModelSerializer.COEFFICIENTS] = (
+        entries[ModelSerializer.COEFFICIENTS][:-8] + b"\0" * 8)
+    with zipfile.ZipFile(path, "w") as z:
+        for n, data in entries.items():
+            z.writestr(n, data)
+    with pytest.raises(CorruptCheckpointError, match="sha256 mismatch"):
+        ModelSerializer.verify(path)
+    with pytest.raises(CorruptCheckpointError):
+        ModelSerializer.restore(path)
+
+
+def test_restore_into_roundtrips_counters_and_rng(tmp_path):
+    path = str(tmp_path / "model.zip")
+    m1 = _model(seed=5)
+    m1.fit(_iter(), epochs=1)
+    ModelSerializer.write_model(m1, path)
+    m2 = _model(seed=99)
+    meta = ModelSerializer.restore_into(m2, path)
+    assert meta["iteration_count"] == m1.iteration_count
+    assert m2.iteration_count == m1.iteration_count
+    assert m2.epoch_count == m1.epoch_count
+    np.testing.assert_array_equal(np.asarray(m2._rng), np.asarray(m1._rng))
+    np.testing.assert_array_equal(_params(m2), _params(m1))
+
+
+# ======================================================================
+# CheckpointManager (zip store)
+# ======================================================================
+
+def test_manager_retention_keeps_best_and_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    m = _model()
+    scores = [5.0, 1.0, 4.0, 3.0, 2.0]   # best (1.0) lands at iteration 2
+    for s in scores:
+        m.iteration_count += 1
+        mgr.save(m, score=s)
+    kept = [it for it, _ in mgr.entries()]
+    assert kept == [2, 4, 5]   # newest 2 + the best-scoring one
+
+
+def test_manager_restore_falls_back_past_corrupt(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    m = _model(seed=11)
+    m.fit(DataSet(XS[:16], YS[:16]))
+    good_params = _params(m)
+    m.iteration_count = 1
+    mgr.save(m)
+    m.fit(DataSet(XS[:16], YS[:16]))
+    mgr.save(m)
+    # truncate the newest checkpoint (torn copy)
+    newest = mgr.entries()[-1][1]
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) // 2)
+    m2 = _model(seed=12)
+    meta = mgr.restore_latest(m2)
+    assert meta is not None and m2.iteration_count == 1
+    np.testing.assert_array_equal(_params(m2), good_params)
+
+
+def test_manager_ignores_stray_files(tmp_path):
+    (tmp_path / "notes.txt").write_text("hi")
+    (tmp_path / "ckpt_tmp.zip").write_text("stray")
+    os.makedirs(tmp_path / "ckpt_9.zip.d")
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.entries() == []
+    assert mgr.restore_latest(_model()) is None
+
+
+# ======================================================================
+# ShardedCheckpoint: commit markers, defensive parsing, retention
+# ======================================================================
+
+def test_sharded_latest_ignores_stray_entries(tmp_path):
+    # regression: int(d.split("_")[1]) used to raise on step_tmp / files
+    d = tmp_path / "ckpts"
+    mgr = ShardedCheckpoint(str(d), keep=2)
+    os.makedirs(d / "step_tmp")
+    os.makedirs(d / "step_1_backup")
+    (d / "stray.json").write_text("{}")
+    (d / "step_0000").write_text("a FILE named like a step dir")
+    assert mgr.latest_step() is None
+    mgr._gc()   # must not crash either
+    m = _model()
+    m.fit(DataSet(XS[:16], YS[:16]))
+    mgr.save(m, 3)
+    assert mgr.latest_step() == 3
+
+
+def test_sharded_uncommitted_step_is_not_a_checkpoint(tmp_path):
+    mgr = ShardedCheckpoint(str(tmp_path / "c"), keep=3)
+    m = _model(seed=21)
+    x, y = XS[:16], YS[:16]
+    m.fit(DataSet(x, y))
+    mgr.save(m, 1)
+    committed = _params(m)
+    m.fit(DataSet(x, y))
+    with crash_at_write("sharded/tree_written"):
+        with pytest.raises(SimulatedCrash):
+            mgr.save(m, 2)   # payload written, COMMIT never lands
+    assert mgr._all_steps() == [1, 2]
+    assert mgr.steps() == [1]
+    assert mgr.latest_step() == 1
+    m2 = _model(seed=22)
+    assert mgr.restore_latest(m2) == 1
+    np.testing.assert_allclose(_params(m2), committed, rtol=1e-12)
+
+
+def test_sharded_gc_keeps_best_and_sweeps_crashed(tmp_path):
+    mgr = ShardedCheckpoint(str(tmp_path / "c"), keep=2)
+    m = _model()
+    m.fit(DataSet(XS[:16], YS[:16]))
+    # a crashed (uncommitted) save, then committed ones with scores
+    with crash_at_write("sharded/tree_written"):
+        with pytest.raises(SimulatedCrash):
+            mgr.save(m, 1)
+    for step, score in [(2, 5.0), (3, 0.5), (4, 4.0), (5, 3.0)]:
+        mgr.save(m, step, score=score)
+    assert mgr.steps() == [3, 4, 5]      # newest 2 + best (step 3)
+    assert mgr.best_step() == 3
+    assert 1 not in mgr._all_steps()     # crashed dir swept by GC
+
+
+# ======================================================================
+# kill-mid-save -> resume equivalence (acceptance)
+# ======================================================================
+
+def test_kill_mid_zip_save_resume_matches_uninterrupted(tmp_path):
+    ref = _model()
+    ref.fit(_iter(), epochs=3)
+
+    d = str(tmp_path / "ck")
+    m1 = _model()
+    with crash_at_write("zip/temp_written", nth=4):
+        with pytest.raises(SimulatedCrash):
+            m1.fit(_iter(), epochs=3, checkpoint_dir=d, checkpoint_every=2)
+    # only complete checkpoints on disk
+    mgr = CheckpointManager(d)
+    assert mgr.entries(), "no committed checkpoint survived the crash"
+    for _, p in mgr.entries():
+        ModelSerializer.verify(p)
+
+    m2 = _model()   # "new process"
+    m2.fit(_iter(), epochs=3, checkpoint_dir=d, checkpoint_every=2,
+           resume=True)
+    assert m2.iteration_count == ref.iteration_count
+    assert m2.epoch_count == ref.epoch_count
+    np.testing.assert_allclose(_params(m2), _params(ref), rtol=1e-12)
+
+
+def test_kill_mid_sharded_save_resume_matches_uninterrupted(tmp_path):
+    it = lambda: _iter(batch=32)
+    ref = ParallelTrainer(_model())
+    ref.fit(it(), epochs=2)
+    ref_params = _params(ref.publish_view())
+
+    d = str(tmp_path / "ck")
+    tr1 = ParallelTrainer(_model())
+    with crash_at_write("sharded/tree_written", nth=2):
+        with pytest.raises(SimulatedCrash):
+            tr1.fit(it(), epochs=2, checkpoint_dir=d, checkpoint_every=2)
+    mgr = ShardedCheckpoint(d)
+    assert mgr.latest_step() is not None
+    assert mgr.latest_step() < max(mgr._all_steps())  # crash left a torn dir
+
+    tr2 = ParallelTrainer(_model())
+    tr2.fit(it(), epochs=2, checkpoint_dir=d, checkpoint_every=2,
+            resume=True)
+    assert tr2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(_params(tr2.publish_view()), ref_params,
+                               rtol=1e-12)
+
+
+def test_graph_fit_resume_matches_uninterrupted(tmp_path):
+    ref = _graph()
+    ref.fit(_iter(), epochs=3)
+
+    d = str(tmp_path / "ck")
+    g1 = _graph()
+    g1.fit(_iter(), epochs=2, checkpoint_dir=d, checkpoint_every=3)
+    g2 = _graph()
+    g2.fit(_iter(), epochs=3, checkpoint_dir=d, resume=True)
+    assert g2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(np.asarray(g2.params_flat()),
+                               np.asarray(ref.params_flat()), rtol=1e-12)
+
+
+def test_fit_scan_resume_matches_uninterrupted(tmp_path):
+    ref = _model()
+    ref.fit_scan(_iter(), epochs=3)
+
+    d = str(tmp_path / "ck")
+    m1 = _model()
+    m1.fit_scan(_iter(), epochs=2, checkpoint_dir=d, checkpoint_every=1)
+    m2 = _model()
+    m2.fit_scan(_iter(), epochs=3, checkpoint_dir=d, resume=True)
+    assert m2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(_params(m2), _params(ref), rtol=1e-12)
+
+
+def test_resume_after_complete_fit_is_noop(tmp_path):
+    d = str(tmp_path / "ck")
+    m1 = _model()
+    m1.fit(_iter(), epochs=2, checkpoint_dir=d)
+    done = _params(m1)
+    m2 = _model()
+    m2.fit(_iter(), epochs=2, checkpoint_dir=d, resume=True)
+    assert m2.iteration_count == m1.iteration_count
+    np.testing.assert_array_equal(_params(m2), done)
+
+
+def test_checkpoint_knob_validation():
+    m = _model()
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        m.fit(_iter(), resume=True)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        m.fit(_iter(), checkpoint_every=5)
+    with pytest.raises(ValueError, match="iterator"):
+        m.fit(DataSet(XS[:16], YS[:16]), checkpoint_dir="/tmp/x")
+
+
+def test_set_epoch_positions_shuffle_permutation():
+    it1 = _iter()
+    orders = []
+    for _ in range(3):
+        it1.reset()
+        while it1.has_next():
+            it1.next()
+        orders.append(np.array(it1._order))
+    it2 = _iter()
+    it2.set_epoch(2)
+    np.testing.assert_array_equal(it2._order, orders[2])
+
+
+def test_sigterm_snapshot_saves_before_exit(tmp_path):
+    m = _model()
+    m.fit(DataSet(XS[:16], YS[:16]))
+    ck = FitCheckpointer(_ZipModelStore(m, str(tmp_path)), every=0)
+    with pytest.raises(SystemExit):
+        with ck.sigterm_snapshot():
+            os.kill(os.getpid(), signal.SIGTERM)
+    entries = CheckpointManager(str(tmp_path)).entries()
+    assert len(entries) == 1
+    with zipfile.ZipFile(entries[0][1]) as z:
+        meta = json.loads(z.read("metadata.json").decode())
+    assert meta["reason"] == "sigterm"
+    # the previous handler is restored
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_sigterm_during_fit_defers_to_batch_boundary(tmp_path):
+    # the handler only sets a flag; on_batch performs the snapshot+exit,
+    # so a signal landing mid-step can never persist torn state
+    m = _model()
+    m.fit(DataSet(XS[:16], YS[:16]))
+    ck = FitCheckpointer(_ZipModelStore(m, str(tmp_path)), every=0)
+    with pytest.raises(SystemExit):
+        with ck.sigterm_snapshot():
+            os.kill(os.getpid(), signal.SIGTERM)
+            # handler ran (flag set), but no save yet — mid-"step" here
+            assert CheckpointManager(str(tmp_path)).entries() == []
+            ck.on_batch()   # first safe boundary -> snapshot + exit
+    entries = CheckpointManager(str(tmp_path)).entries()
+    assert len(entries) == 1
+    with zipfile.ZipFile(entries[0][1]) as z:
+        assert json.loads(z.read("metadata.json").decode())["reason"] \
+            == "sigterm"
+
+
+def test_sharded_legacy_unmarked_dirs_restorable_and_not_gced(tmp_path):
+    # dirs written by the pre-COMMIT-marker layout: no marker, complete
+    # payload. They must stay restorable and must survive GC.
+    from deeplearning4j_tpu.parallel.checkpoint import save_sharded
+
+    d = tmp_path / "c"
+    m = _model(seed=41)
+    m.fit(DataSet(XS[:16], YS[:16]))
+    legacy = _params(m)
+    save_sharded(str(d / "step_000000001"), m)   # old writer: no marker
+    mgr = ShardedCheckpoint(str(d), keep=1)
+    assert mgr.steps() == []                     # not trusted as committed
+    m2 = _model(seed=43)
+    assert mgr.restore_latest(m2) == 1           # ...but restorable
+    np.testing.assert_allclose(_params(m2), legacy, rtol=1e-12)
+    # a new committed save must NOT sweep the foreign marker-less dir
+    m.fit(DataSet(XS[:16], YS[:16]))
+    mgr.save(m, 5)
+    assert 1 in mgr._all_steps()
+    assert mgr.latest_step() == 5
+
+
+def test_backprop_false_rejects_fault_knobs():
+    m = _model()
+    m.conf.backprop = False
+    with pytest.raises(ValueError, match="backprop"):
+        m.fit(_iter(), checkpoint_dir="/tmp/x")
+    with pytest.raises(ValueError, match="backprop"):
+        m.fit(_iter(), guard=TrainingGuard("warn"))
+
+
+# ======================================================================
+# TrainingGuard (acceptance: NaN batch under skip_batch)
+# ======================================================================
+
+def test_guard_skip_batch_nan_counted_and_converges():
+    m = _model()
+    guard = TrainingGuard("skip_batch")
+    with telemetry.enabled() as sess:
+        m.fit(FaultyIterator(_iter(), nan_at=3), epochs=25, guard=guard)
+    assert guard.nonfinite_steps == 1
+    assert guard.skipped_batches == 1
+    counter = sess.registry.get("dl4j_fault_nonfinite_steps_total")
+    assert counter.value(policy="skip_batch") == 1
+    assert sess.fault_summary()["nonfinite_steps"] == 1
+    # params never saw the poisoned batch: training still converges
+    ev = m.evaluate(ArrayDataSetIterator(XS, YS, batch_size=64))
+    assert ev.accuracy() > 0.9, ev.stats()
+    assert np.isfinite(_params(m)).all()
+
+
+def test_guard_halt_raises():
+    m = _model()
+    with pytest.raises(NonFiniteScoreError, match="policy=halt"):
+        m.fit(FaultyIterator(_iter(), nan_at=2), epochs=1,
+              guard=TrainingGuard("halt"))
+
+
+def test_guard_warn_keeps_poisoned_step():
+    m = _model()
+    guard = TrainingGuard("warn", max_consecutive=50)
+    m.fit(FaultyIterator(_iter(), nan_at=2), epochs=1, guard=guard)
+    assert guard.nonfinite_steps >= 1
+    assert guard.skipped_batches == 0
+    # warn keeps the bad step: params are now poisoned (that's the point)
+    assert not np.isfinite(_params(m)).all()
+
+
+def test_guard_rollback_restores_known_good():
+    m = _model()
+    guard = TrainingGuard("rollback", refresh_every=2)
+    m.fit(FaultyIterator(_iter(), nan_at=7), epochs=2, guard=guard)
+    assert guard.skipped_batches == 1
+    assert np.isfinite(_params(m)).all()
+    assert np.isfinite(float(np.asarray(m._score)))
+
+
+def test_guard_max_consecutive_refuses_to_spin():
+    xs = np.full_like(XS, np.nan)
+    m = _model()
+    guard = TrainingGuard("skip_batch", max_consecutive=3)
+    with pytest.raises(NonFiniteScoreError, match="consecutive"):
+        m.fit(_iter(xs=xs), epochs=5, guard=guard)
+
+
+def test_guard_scan_epoch_discard():
+    xs = XS.copy()
+    xs[5, 0] = np.nan   # poisons every epoch's scores under scan
+    m = _model()
+    guard = TrainingGuard("skip_batch", max_consecutive=10)
+    m.fit_scan(_iter(xs=xs), epochs=3, guard=guard)
+    # every epoch contains the bad batch -> every epoch discarded
+    assert guard.nonfinite_steps >= 3
+    assert np.isfinite(_params(m)).all()
+
+
+def test_guard_scan_discard_balances_epoch_listeners():
+    class EpochCounter:
+        def __init__(self):
+            self.starts = 0
+            self.ends = 0
+
+        def iteration_done(self, model, iteration):
+            pass
+
+        def on_epoch_start(self, model):
+            self.starts += 1
+
+        def on_epoch_end(self, model):
+            self.ends += 1
+
+    xs = XS.copy()
+    xs[5, 0] = np.nan
+    m = _model()
+    lis = EpochCounter()
+    m.set_listeners(lis)
+    m.fit_scan(_iter(xs=xs), epochs=3,
+               guard=TrainingGuard("skip_batch", max_consecutive=10))
+    assert lis.starts == lis.ends == 3   # discarded epochs still balanced
+
+
+def test_guard_skip_batch_on_parallel_trainer():
+    tr = ParallelTrainer(_model())
+    guard = TrainingGuard("skip_batch")
+    tr.fit(FaultyIterator(_iter(batch=32), nan_at=2), epochs=2, guard=guard)
+    assert guard.skipped_batches == 1
+    assert np.isfinite(_params(tr.publish_view())).all()
+
+
+def test_guard_rollback_on_scan_path():
+    # regression: rollback under fit_scan crashed with _known_good=None
+    # (only run_step ever seeded it); now a bad first epoch falls back to
+    # the pre-epoch snapshot and finite epochs refresh the known-good
+    xs = XS.copy()
+    xs[5, 0] = np.nan
+    m = _model()
+    guard = TrainingGuard("rollback", refresh_every=1, max_consecutive=10)
+    m.fit_scan(_iter(xs=xs), epochs=3, guard=guard)
+    assert guard.nonfinite_steps >= 3
+    assert np.isfinite(_params(m)).all()
+
+
+def test_sigterm_snapshot_honors_sig_ign(tmp_path):
+    # regression: an app that deliberately ignores SIGTERM must not be
+    # killed by the snapshot handler — save, then stay alive
+    m = _model()
+    m.fit(DataSet(XS[:16], YS[:16]))
+    prev = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    try:
+        ck = FitCheckpointer(_ZipModelStore(m, str(tmp_path)), every=0)
+        with ck.sigterm_snapshot():
+            os.kill(os.getpid(), signal.SIGTERM)   # must NOT raise
+        assert len(CheckpointManager(str(tmp_path)).entries()) == 1
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_IGN
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_guard_retry_recovers_transient_error():
+    m = _model()
+    guard = TrainingGuard("warn", backoff_s=0.001)
+    with telemetry.enabled() as sess:
+        m.fit(FaultyIterator(_iter(), raise_at=2, fail_times=2), epochs=1,
+              guard=guard)
+    assert m.iteration_count == 6          # all 6 batches trained
+    retries = sess.registry.get("dl4j_fault_retries_total")
+    assert retries.value(kind="iterator") == 2
+
+
+def test_guard_retry_gives_up_on_permanent_error():
+    m = _model()
+    guard = TrainingGuard("warn", max_retries=2, backoff_s=0.001)
+    with pytest.raises(OSError, match="injected"):
+        m.fit(FaultyIterator(_iter(), raise_at=1, fail_times=-1), epochs=1,
+              guard=guard)
+
+
+def test_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="unknown guard policy"):
+        TrainingGuard("explode")
+
+
+def test_faulty_iterator_ordinals_count_across_epochs():
+    # 6 batches/epoch; ordinal 8 is the 3rd batch of epoch 2
+    base = _iter()
+    f = FaultyIterator(base, raise_at=8, fail_times=1, exc=RuntimeError)
+    served = 0
+    with pytest.raises(RuntimeError):
+        for _ in range(2):
+            f.reset()
+            while f.has_next():
+                f.next()
+                served += 1
+    assert served == 8
+
+
+# ======================================================================
+# satellites: earlystopping + LocalFileModelSaver
+# ======================================================================
+
+def test_loss_calculator_empty_iterator_raises():
+    from deeplearning4j_tpu.earlystopping import DataSetLossCalculator
+
+    class EmptyIter(ArrayDataSetIterator):
+        def has_next(self):
+            return False
+
+    calc = DataSetLossCalculator(EmptyIter(XS, YS, batch_size=16))
+    with pytest.raises(ValueError, match="no.*examples|yielded no"):
+        calc.calculate_score(_model())
+
+
+def test_invalid_score_termination_fires_on_nan():
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer,
+        InvalidScoreIterationTerminationCondition,
+        MaxEpochsTerminationCondition)
+
+    xs = np.full_like(XS, np.nan)   # loss is NaN from the first step
+    conf = (EarlyStoppingConfiguration.Builder()
+            .iteration_termination_conditions(
+                InvalidScoreIterationTerminationCondition())
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .build())
+    result = EarlyStoppingTrainer(conf, _model(), _iter(xs=xs)).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+    assert (result.termination_details
+            == "InvalidScoreIterationTerminationCondition")
+
+
+def test_local_file_saver_crash_preserves_previous_best(tmp_path):
+    from deeplearning4j_tpu.earlystopping import LocalFileModelSaver
+
+    saver = LocalFileModelSaver(str(tmp_path))
+    m1 = _model(seed=31)
+    m1.fit(DataSet(XS[:16], YS[:16]))
+    saver.save_best_model(m1, 0.5)
+    m2 = _model(seed=32)
+    with crash_at_write("zip/temp_written"):
+        with pytest.raises(SimulatedCrash):
+            saver.save_best_model(m2, 0.4)
+    # previous best intact and loadable — not destroyed by the torn save
+    best = saver.get_best_model()
+    np.testing.assert_array_equal(_params(best), _params(m1))
+
+
+# ======================================================================
+# telemetry integration
+# ======================================================================
+
+def test_checkpoint_timers_land_in_fault_summary(tmp_path):
+    m = _model()
+    with telemetry.enabled() as sess:
+        m.fit(_iter(), epochs=1, checkpoint_dir=str(tmp_path / "ck"))
+        m2 = _model()
+        m2.fit(_iter(), epochs=1, checkpoint_dir=str(tmp_path / "ck"),
+               resume=True)
+        summary = sess.summary()
+    fs = summary["fault"]
+    assert fs["checkpoint_saves"]["zip"] >= 1
+    assert fs["checkpoint_restores"]["zip"] >= 1
+    assert fs["checkpoint_save_s"]["zip"] > 0
