@@ -11,6 +11,10 @@ Covers all five BASELINE.md configs:
      virtual mesh (parallel.scaling_bench, subprocess so it can force the
      CPU platform)
 
+Plus extras: input-pipeline before/after, checkpoint save/restore cost,
+GPipe bubble curve, and the serving plane's p50/p99 latency + req/s
+(batched vs unbatched closed-loop clients, serving/bench.py).
+
 The reference publishes no numbers (BASELINE.json "published": {}), so
 vs_baseline is the ratio against round-1's first measured value
 (BENCH_BASELINE.json).
@@ -257,6 +261,20 @@ def main():
         extras["Checkpoint-zip-ms"] = bench_checkpoint()
     except Exception as e:
         extras["Checkpoint-zip-ms"] = f"error: {type(e).__name__}"
+    try:
+        # serving plane (ISSUE 7): p50/p99 latency + req/s through the
+        # registry+batcher data plane at 1/8/32 concurrent closed-loop
+        # clients, batched vs unbatched, for LeNet (conv; compute-bound
+        # on a CPU sandbox) and a dispatch-bound MLP head. Also asserts
+        # one XLA compile per (model, bucket) across the run and a
+        # zero-failed-requests hot-swap under 16-client load. Runs under
+        # its own telemetry session (run_serving_bench) so its compile
+        # counts don't pollute the training numbers.
+        from deeplearning4j_tpu.serving.bench import run_serving_bench
+        extras["Serving-latency"] = run_serving_bench(
+            clients=(1, 8, 32), requests_per_client=120)
+    except Exception as e:
+        extras["Serving-latency"] = f"error: {type(e).__name__}"
     try:
         pipe = bench_pipeline(8)
         if pipe:

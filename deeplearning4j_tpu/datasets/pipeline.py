@@ -47,7 +47,7 @@ from .iterators import (AsyncDataSetIterator, DataSet, DataSetIterator,
                         MultiDataSet)
 
 __all__ = ["PadToBatchIterator", "DevicePrefetchIterator", "pad_dataset",
-           "build_pipeline"]
+           "pad_rows", "build_pipeline"]
 
 
 # ---------------------------------------------------------------------------
@@ -95,11 +95,18 @@ def _per_example_mask_shape(labels: np.ndarray) -> tuple:
     return labels.shape[:-1] if labels.ndim >= 2 else (labels.shape[0],)
 
 
-def _pad_rows(a, n_pad):
+def pad_rows(a, n_pad):
+    """Append `n_pad` zero rows along axis 0 (the PadToBatch row shaping,
+    shared with the serving plane's DynamicBatcher: requests coalesce into
+    fixed-shape buckets by padding with zero rows, and the pad rows are
+    stripped before results scatter back to waiters)."""
     if a is None or n_pad == 0:
         return a
     return np.concatenate(
         [a, np.zeros((n_pad,) + a.shape[1:], dtype=a.dtype)], axis=0)
+
+
+_pad_rows = pad_rows
 
 
 def _pad_time(a, t_pad, axis=1):

@@ -15,6 +15,14 @@ job is remote control, not language bridging):
     POST /output {"model": "/path/model.h5", "features": [[...]]}
                                                    -> predictions
     GET  /ping                                     -> {"status": "ok"}
+
+`/output` is served by the production inference plane (`serving/`):
+models register into a ModelRegistry on first use (loaded + AOT-compiled
+once, NOT per request) and concurrent requests run through compiled
+bucket executables without any global lock. `/fit` still serializes
+training under a fit lock. The full serving surface (multi-model
+versioning, hot-swap, dynamic batching, /metrics) lives in
+`deeplearning4j_tpu.serving.server`.
 """
 from __future__ import annotations
 
@@ -91,44 +99,104 @@ class HDF5MiniBatchDataSetIterator(DataSetIterator):
 
 class DeepLearning4jEntryPoint:
     """The fit/predict entry point (`DeepLearning4jEntryPoint.java:21`).
-    A single lock serializes model loading and training: the server is
-    threaded for request handling, but two concurrent fits on one network
-    would interleave weight updates."""
 
-    def __init__(self):
+    Locking is split by what actually needs serializing: `_cache_lock`
+    guards ONLY model-cache lookup/load, and `_fit_lock` serializes
+    training (two concurrent fits on one network would interleave weight
+    updates). Inference takes neither across the forward — concurrent
+    `/output` requests run in parallel through the serving registry's
+    compiled executables instead of queueing behind one global lock (the
+    old design held a single lock across the entire forward pass).
+
+    `/output` routes through a `serving.ModelRegistry`: the model loads
+    and AOT-compiles once at first use, every later request hits the
+    registry's compiled bucket executables, and a completed `/fit`
+    hot-swaps the registry version so predictions follow training."""
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from ..serving import ModelRegistry
+            registry = ModelRegistry()
+        self.registry = registry
         self._models: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._cache_lock = threading.Lock()
+        self._fit_lock = threading.Lock()
 
-    def _load_locked(self, model_path: str):
-        if model_path not in self._models:
-            from .keras import import_keras_sequential_model_and_weights
-            self._models[model_path] = \
-                import_keras_sequential_model_and_weights(model_path)
-        return self._models[model_path]
+    def _load(self, model_path: str):
+        """Cache lookup/load — the ONLY thing the cache lock covers."""
+        with self._cache_lock:
+            net = self._models.get(model_path)
+            if net is None:
+                from .keras import import_keras_sequential_model_and_weights
+                net = self._models[model_path] = \
+                    import_keras_sequential_model_and_weights(model_path)
+            return net
 
     def fit(self, model_path: str, data_dir: str, epochs: int = 1,
             save_to: Optional[str] = None) -> Dict:
-        with self._lock:
-            net = self._load_locked(model_path)
+        net = self._load(model_path)
+        with self._fit_lock:
             it = HDF5MiniBatchDataSetIterator(data_dir)
             net.fit(it, epochs=int(epochs))
             if save_to:
                 from ..util.serializer import ModelSerializer
                 ModelSerializer.write_model(net, save_to)
-            return {"status": "ok", "score": float(net.score()),
-                    "iterations": int(net.iteration_count)}
+            result = {"status": "ok", "score": float(net.score()),
+                      "iterations": int(net.iteration_count)}
+        if model_path in self.registry:
+            # hot-swap the served snapshot so /output reflects the fit;
+            # same architecture -> the registry reuses its executables.
+            # Keep the served input shape: configs without a derivable
+            # one were registered with a request-inferred shape, and the
+            # swap must not fail a fit that succeeded
+            served = self.registry.get(model_path)
+            self.registry.swap(model_path, net,
+                               input_shape=served.example_shape)
+        return result
 
-    def output(self, model_path: str, features: np.ndarray) -> np.ndarray:
-        with self._lock:
-            net = self._load_locked(model_path)
-            return np.asarray(net.output(np.asarray(features, np.float32)))
+    def _ensure_served(self, model_path: str, net, features: np.ndarray):
+        from ..serving import ServingError
+        try:
+            return self.registry.ensure(model_path, net)
+        except ServingError:
+            # model config declares no fixed input shape (some imported
+            # configs) — fall back to the request's trailing shape
+            return self.registry.ensure(model_path, net,
+                                        input_shape=features.shape[1:])
+
+    def output(self, model_path: str, features) -> np.ndarray:
+        net = self._load(model_path)
+        features = np.asarray(features, np.float32)
+        if features.ndim == 1:
+            features = features[None]
+        v = self._ensure_served(model_path, net, features)
+        if tuple(features.shape[1:]) != v.example_shape:
+            # the legacy contract accepts shape-varying requests (e.g.
+            # variable-length sequences into an RNN import); the serving
+            # plane compiles fixed buckets, so off-shape requests keep
+            # the old direct net.output() path (jit retraces per shape,
+            # exactly as before — and still with no global lock)
+            return np.asarray(net.output(features))
+        out, _ = self.registry.predict(model_path, features)
+        return out
 
 
 class KerasBackendServer:
-    """HTTP control server wrapping the entry point (`Server.java:15`)."""
+    """HTTP control server wrapping the entry point (`Server.java:15`).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        entry = self.entry_point = DeepLearning4jEntryPoint()
+    Error semantics match the serving plane (`serving/server.py`): a
+    client mistake (malformed JSON, missing keys, bad shapes, nonexistent
+    model path) is 400 with a structured `{"error": ...}` body; 500 is
+    reserved for genuine server faults."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry=None):
+        from ..serving import ServingError
+        from ..serving.server import ClientError, parse_json_body, require
+
+        entry = self.entry_point = DeepLearning4jEntryPoint(
+            registry=registry)
+        self.registry = entry.registry
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):   # quiet
@@ -146,25 +214,29 @@ class KerasBackendServer:
                 if self.path == "/ping":
                     self._reply(200, {"status": "ok"})
                 else:
-                    self._reply(404, {"error": "unknown path"})
+                    self._reply(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path not in ("/fit", "/output"):
+                        self._reply(404,
+                                    {"error": f"unknown path {self.path}"})
+                        return
+                    body = parse_json_body(self)
                     if self.path == "/fit":
-                        out = entry.fit(body["model"], body["data_dir"],
+                        out = entry.fit(require(body, "model"),
+                                        require(body, "data_dir"),
                                         body.get("epochs", 1),
                                         body.get("save_to"))
                         self._reply(200, out)
-                    elif self.path == "/output":
-                        preds = entry.output(
-                            body["model"], np.asarray(body["features"],
-                                                      np.float32))
-                        self._reply(200, {"output": preds.tolist()})
                     else:
-                        self._reply(404, {"error": "unknown path"})
-                except Exception as e:   # surface errors to the client
+                        preds = entry.output(require(body, "model"),
+                                             require(body, "features"))
+                        self._reply(200, {"output": preds.tolist()})
+                except (ClientError, ServingError, FileNotFoundError,
+                        ValueError, TypeError) as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:   # genuine server fault
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

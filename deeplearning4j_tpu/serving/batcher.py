@@ -1,0 +1,330 @@
+"""Dynamic request batching: coalesce concurrent predict requests into
+padded fixed-shape batches (Clipper-style adaptive batching / ORCA-style
+request coalescing applied to our bucketed executables).
+
+A request enqueues its rows and blocks on an event; the single worker
+thread drains the queue when either (a) enough rows have accumulated to
+fill `max_batch`, or (b) the OLDEST queued request has waited
+`max_wait_us` — the classic max-wait/max-batch tradeoff knob. The drained
+rows are stacked, padded with zero rows up to the smallest compiled
+bucket that fits (`datasets.pipeline.pad_rows` — the PadToBatch shaping
+reused on the serving path), run through ONE compiled forward, and the
+per-row results scatter back to their waiters.
+
+Error isolation: shape validation happens at submit() time on the
+CALLER's thread, so a malformed request fails alone with a client error
+and never enters a batch. A failure inside the batched forward itself
+(a genuine server fault) fails exactly the requests in that batch;
+later requests get a fresh batch.
+
+Version consistency: the runner callable is expected to resolve the
+current ServableVersion once per FLUSH, so every row in a batch is
+served by one version and versions observed by a client are monotonic
+(one worker, FIFO flushes)."""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..datasets.pipeline import pad_rows
+from .registry import ServingError
+
+__all__ = ["DynamicBatcher", "BatcherClosedError"]
+
+# one reusable completion Event per client thread: submit() is blocking,
+# so a thread has at most one pending request, and recycling the pthread
+# primitives shaves measurable per-request overhead at high concurrency
+_tls = threading.local()
+
+
+def _thread_event() -> threading.Event:
+    ev = getattr(_tls, "event", None)
+    if ev is None:
+        ev = _tls.event = threading.Event()
+    ev.clear()
+    return ev
+
+
+class BatcherClosedError(RuntimeError):
+    """submit() after stop() — the serving plane is shutting down."""
+
+
+class _Pending:
+    __slots__ = ("x", "rows", "event", "result", "version", "error",
+                 "enqueued_at")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.event = _thread_event()
+        self.result: Optional[np.ndarray] = None
+        self.version: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Coalesces concurrent `submit()` calls into bucket-shaped batches.
+
+    runner(x_padded, bucket) -> (outputs, version): ONE compiled forward
+        over a `[bucket, ...]` batch (registry._predict path).
+    bucket_for(rows) -> bucket: smallest compiled bucket holding `rows`
+        (raises for oversize requests — validated on the caller's thread).
+    max_batch: row budget per flush; defaults to the largest bucket.
+    max_wait_us: the oldest request never waits longer than this for
+        co-batching before the worker flushes a partial batch.
+    """
+
+    def __init__(self, runner: Callable, bucket_for: Callable[[int], int],
+                 max_batch: int, max_wait_us: int = 2000,
+                 name: str = "model", metrics=None,
+                 buckets: Optional[Tuple[int, ...]] = None):
+        self._runner = runner
+        self._bucket_for = bucket_for
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_us) / 1e6)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self._flush_ema: dict = {}   # bucket -> EMA flush seconds
+        self.name = name
+        # enqueue is lock-free: deque.append is atomic under the GIL and
+        # the worker is the only consumer, so clients pay one append + one
+        # Event.set per request instead of a contended mutex round trip
+        self._queue: collections.deque = collections.deque()
+        self._wake = threading.Event()
+        self._stopped = False
+        self._batch_size_h = self._queue_wait_h = self._rows_c = None
+        if metrics is not None:
+            self._batch_size_h = metrics.histogram(
+                "dl4j_serving_batch_size",
+                "real (unpadded) rows per batched forward",
+                labels=("model",),
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+            self._queue_wait_h = metrics.histogram(
+                "dl4j_serving_queue_wait_seconds",
+                "seconds a request waited in the batching queue before "
+                "its flush started", labels=("model",))
+            self._rows_c = metrics.counter(
+                "dl4j_serving_batch_rows_total",
+                "rows through the batched path by kind (real vs padding)",
+                labels=("model", "kind"))
+        self._worker = threading.Thread(
+            target=self._loop, name=f"dl4j-serving-batcher-{name}",
+            daemon=True)
+        self._worker.start()
+
+    # -- client side -----------------------------------------------------
+    def submit(self, x: np.ndarray, timeout: float = 30.0
+               ) -> Tuple[np.ndarray, int]:
+        """Block until this request's rows come back from a batched
+        forward. Returns `(outputs, version)`; raises the batch's error if
+        its forward failed, BatcherClosedError after stop()."""
+        if int(x.shape[0]) > self.max_batch:   # oversize fails HERE, alone
+            raise ServingError(
+                f"request of {int(x.shape[0])} rows exceeds max_batch "
+                f"{self.max_batch} for '{self.name}' — the direct path "
+                "chunks oversize requests; the batcher never splits one")
+        if self._stopped:
+            raise BatcherClosedError(f"batcher for '{self.name}' is stopped")
+        p = _Pending(x)
+        self._queue.append(p)
+        self._wake.set()
+        if self._stopped and not p.event.is_set():
+            # raced a concurrent stop(): the worker may already be gone —
+            # reclaim the request instead of blocking out the timeout
+            try:
+                self._queue.remove(p)
+                raise BatcherClosedError(
+                    f"batcher for '{self.name}' is stopped")
+            except ValueError:
+                pass          # the drain took it; wait for its result
+        if not p.event.wait(timeout):
+            try:
+                self._queue.remove(p)   # don't waste a flush on a waiter
+            except ValueError:          # that's gone
+                pass
+            # orphan the thread-local event: an in-flight flush still
+            # holds this pending and may set() it later — recycling it
+            # into the thread's next request would spuriously wake that
+            # unrelated request
+            _tls.event = None
+            raise TimeoutError(
+                f"batched predict on '{self.name}' timed out after "
+                f"{timeout:.1f}s")
+        if p.error is not None:
+            raise p.error
+        return p.result, p.version
+
+    def stop(self, drain: bool = True):
+        """Stop the worker. With `drain` (default) queued requests are
+        flushed first — shutdown never drops accepted work; without it
+        they fail with BatcherClosedError."""
+        if self._stopped:
+            return
+        if not drain:
+            self._fail_queued()
+        self._stopped = True
+        self._wake.set()
+        self._worker.join(timeout=10.0)
+        self._fail_queued()   # anything the worker didn't get to
+
+    def _fail_queued(self):
+        while True:
+            try:
+                p = self._queue.popleft()
+            except IndexError:
+                return
+            p.error = BatcherClosedError(
+                f"batcher for '{self.name}' stopped")
+            p.event.set()
+
+    # -- worker side -----------------------------------------------------
+    def _est_flush_s(self, bucket: int) -> Optional[float]:
+        """EMA flush seconds for `bucket`; unsampled buckets are estimated
+        by LINEAR scaling from the nearest sampled one — deliberately
+        pessimistic (assumes zero batching amortization), so an unsampled
+        small bucket looks exactly break-even and gets tried, then its
+        real cost takes over."""
+        t = self._flush_ema.get(bucket)
+        if t is not None:
+            return t
+        if not self._flush_ema:
+            return None
+        b0 = min(self._flush_ema, key=lambda b: abs(b - bucket))
+        return self._flush_ema[b0] * bucket / b0
+
+    def _flush_budget(self, avail: int) -> int:
+        """Row budget for a deadline flush.
+
+        Padding up is not always right: 18 rows queued against buckets
+        (1, 8, 32) would run a 32-wide forward nearly half empty, while
+        flushing one full 8 and leaving 10 queued (their original
+        enqueue-time deadlines still bind) keeps executable utilization
+        high. Which choice wins depends on the measured per-bucket flush
+        cost, so the batcher picks adaptively: flush all `avail` rows
+        padded up to the next bucket, or only the largest full bucket's
+        worth — whichever yields more rows/second under the flush-time
+        EMAs (Clipper-style adaptive batch sizing)."""
+        if self.buckets is None:
+            return self.max_batch
+        # a flush can never exceed the largest compiled bucket — a
+        # max_batch configured above it must not poison whole batches
+        # with bucket_for() failures at flush time
+        cap = min(self.max_batch, self.buckets[-1])
+        if avail >= cap:
+            return cap
+        up = next((b for b in self.buckets if b >= avail),
+                  self.buckets[-1])
+        full = [b for b in self.buckets if b <= avail]
+        if not full or full[-1] == up:
+            return avail
+        fb = max(full)
+        t_up, t_fb = self._est_flush_s(up), self._est_flush_s(fb)
+        if not t_up or not t_fb:
+            return avail
+        return avail if avail / t_up >= fb / t_fb else fb
+
+    def _queued_rows(self) -> int:
+        # worker-side snapshot; clients only append, so this can lag but
+        # never overcounts what the take loop will find. Iterating the
+        # deque races concurrent appends ("deque mutated during
+        # iteration") — retry, then fall back to len() (an undercount
+        # only for multi-row requests, which just means one earlier
+        # flush; the take loop re-reads the real rows)
+        for _ in range(3):
+            try:
+                return sum(p.rows for p in self._queue)
+            except RuntimeError:
+                continue
+        return len(self._queue)
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Wait for work, then for either a full batch or the oldest
+        request's max-wait deadline; dequeue FIFO without splitting any
+        request. Returns None when stopped and drained."""
+        queue, wake = self._queue, self._wake
+        while not queue:
+            if self._stopped:
+                return None
+            wake.wait(timeout=0.05)
+            wake.clear()
+        deadline = queue[0].enqueued_at + self.max_wait_s
+        avail = self._queued_rows()
+        while avail < self.max_batch and not self._stopped:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            wake.wait(timeout=remaining)
+            wake.clear()
+            avail = self._queued_rows()
+        budget = self._flush_budget(min(avail, self.max_batch))
+        taken, rows = [], 0
+        while queue and rows + queue[0].rows <= budget:
+            p = queue.popleft()
+            rows += p.rows
+            taken.append(p)
+        if not taken and queue:
+            # head request alone exceeds the budget (multi-row request
+            # against a small-bucket budget) — flush it by itself rather
+            # than deadlock on it
+            taken.append(queue.popleft())
+        return taken
+
+    def _flush(self, taken: List[_Pending]):
+        rows = sum(p.rows for p in taken)
+        t_flush = time.perf_counter()
+        scattered = 0
+        if self._queue_wait_h is not None:
+            for p in taken:
+                self._queue_wait_h.observe(t_flush - p.enqueued_at,
+                                           model=self.name)
+        try:
+            x = (taken[0].x if len(taken) == 1
+                 else np.concatenate([p.x for p in taken], axis=0))
+            bucket = self._bucket_for(rows)
+            t0 = time.perf_counter()
+            out, version = self._runner(pad_rows(x, bucket - rows), bucket)
+            dt = time.perf_counter() - t0
+            prev = self._flush_ema.get(bucket)   # worker-thread-only state
+            self._flush_ema[bucket] = dt if prev is None \
+                else 0.5 * prev + 0.5 * dt
+            if self._batch_size_h is not None:
+                self._batch_size_h.observe(rows, model=self.name)
+                self._rows_c.inc(rows, model=self.name, kind="real")
+                if bucket - rows:
+                    self._rows_c.inc(bucket - rows, model=self.name,
+                                     kind="pad")
+            lo = 0
+            for p in taken:
+                p.result = out[lo:lo + p.rows]
+                p.version = version
+                lo += p.rows
+                scattered += 1
+                p.event.set()
+        except BaseException as e:   # fail THIS batch, keep serving
+            # fail exactly the requests not yet scattered — a scattered
+            # request's client may already have recycled its thread-local
+            # event into a NEW pending, so touching its event again would
+            # spuriously wake that unrelated request
+            for p in taken[scattered:]:
+                p.error = e
+                p.event.set()
+
+    def _loop(self):
+        while True:
+            try:
+                taken = self._take_batch()
+            except (IndexError, RuntimeError):
+                # IndexError: a timed-out client's queue.remove() emptied
+                # the queue between the emptiness check and the head
+                # peek. RuntimeError: belt-and-suspenders for any deque
+                # mutation race — the worker must NEVER die, or every
+                # batched request times out forever
+                continue
+            if taken is None:
+                return
+            if taken:
+                self._flush(taken)

@@ -1,0 +1,407 @@
+"""Production inference HTTP plane: the registry + batcher behind a
+threaded stdlib server (the grown-up version of `modelimport/server.py`'s
+toy `/output` endpoint).
+
+Endpoints
+    GET  /v1/models                   -> {"models": [info, ...]}
+    GET  /v1/models/<name>            -> info
+    POST /v1/models/<name>/predict    {"features": [[...]], "batched": bool?}
+                                      -> {"output": ..., "version": N,
+                                          "batched": bool}
+    POST /v1/models/<name>/swap       {"source": "/ckpt.zip"|dir|h5,
+                                       "precision"?, "buckets"?,
+                                       "input_shape"?}
+                                      -> {"model":, "version":, ...}
+    GET  /healthz                     -> {"status": "ok", "models": {...}}
+    GET  /metrics                     -> Prometheus text (0.0.4)
+
+Error semantics: 400 + {"error": ...} for client mistakes (malformed
+JSON, missing keys, shape mismatches, unknown precision), 404 for
+unknown models/paths, 500 only for genuine server faults. Hot-swap via
+POST /swap compiles the incoming version entirely off the request path
+and flips atomically — concurrent predicts never fail or observe a
+version decrease during a swap.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .batcher import BatcherClosedError, DynamicBatcher
+from .registry import (ModelRegistry, ServingError, UnknownModelError,
+                       _validate_features)
+
+__all__ = ["InferenceServer", "ClientError"]
+
+_MODEL_PATH = re.compile(r"^/v1/models/([^/]+)(?:/(predict|swap))?$")
+
+
+class ClientError(ValueError):
+    """Request the client got wrong -> HTTP 400 with a structured body."""
+
+
+def parse_json_body(handler: BaseHTTPRequestHandler) -> Dict:
+    """Read+parse a JSON request body; client mistakes raise ClientError
+    (-> 400), never a bare exception (-> 500). Shared with the legacy
+    Keras backend server so both planes agree on error semantics."""
+    try:
+        n = int(handler.headers.get("Content-Length", "0"))
+    except ValueError:
+        raise ClientError("invalid Content-Length header") from None
+    raw = handler.rfile.read(n) if n else b""
+    if not raw:
+        raise ClientError("empty request body (expected JSON)")
+    try:
+        body = json.loads(raw)
+    except ValueError as e:
+        raise ClientError(f"malformed JSON body: {e}") from None
+    if not isinstance(body, dict):
+        raise ClientError("JSON body must be an object")
+    return body
+
+
+def require(body: Dict, key: str):
+    if key not in body:
+        raise ClientError(f"missing required key {key!r}")
+    return body[key]
+
+
+class InferenceServer:
+    """HTTP front end over a ModelRegistry with per-model dynamic
+    batching. `batching=False` serves every request on the direct
+    (chunk+pad, still AOT-compiled) path — the bench's unbatched arm."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 batching: bool = True, max_wait_us: int = 2000,
+                 max_batch: Optional[int] = None):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.batching = bool(batching)
+        self.max_wait_us = int(max_wait_us)
+        self.max_batch = max_batch
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._batchers_lock = threading.Lock()
+        self._stopping = False
+        self._started_at = time.time()
+        m = self.registry.metrics
+        self._requests = m.counter(
+            "dl4j_serving_requests_total",
+            "serving HTTP requests by endpoint and status code",
+            labels=("model", "endpoint", "code"))
+        self._latency = m.histogram(
+            "dl4j_serving_latency_seconds",
+            "request latency through the serving data plane (queue wait + "
+            "forward) by path", labels=("model", "path"))
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- data plane (also driven directly by serving/bench.py) ----------
+    def _batcher(self, name: str) -> DynamicBatcher:
+        b = self._batchers.get(name)   # GIL-atomic fast path, no mutex
+        if b is not None:
+            return b
+        with self._batchers_lock:
+            if self._stopping:
+                # an in-flight request racing stop() must not install a
+                # fresh batcher after the drain pass — its worker would
+                # leak. Checked INSIDE the lock: stop() sets the flag
+                # before taking this lock for the drain, so a creator
+                # either finishes first (and gets drained) or sees it
+                raise BatcherClosedError("server is stopping")
+            b = self._batchers.get(name)
+            if b is None:
+                reg = self.registry
+
+                def runner(x_padded, bucket, _name=name):
+                    v = reg.get(_name)
+                    if bucket in v.runners:
+                        return v.run_padded(x_padded, bucket), v.version
+                    # a swap changed the bucket set between enqueue and
+                    # flush: serve via the direct path (pad rows ride
+                    # along; the batcher scatters only the real rows)
+                    return reg.predict(_name, x_padded)
+
+                v = reg.get(name)
+                b = DynamicBatcher(
+                    runner,
+                    bucket_for=lambda rows, _n=name:
+                        reg.get(_n).bucket_for(rows),
+                    # clamped: a flush can never exceed the largest
+                    # compiled bucket, and requests beyond it must route
+                    # to the direct path (which chunks) instead
+                    max_batch=min(self.max_batch or v.buckets[-1],
+                                  v.buckets[-1]),
+                    max_wait_us=self.max_wait_us, name=name,
+                    metrics=reg.metrics, buckets=v.buckets)
+                self._batchers[name] = b
+            return b
+
+    def predict(self, name: str, features, batched: Optional[bool] = None
+                ) -> Tuple[np.ndarray, int, str]:
+        """(outputs, version, path) where path is 'batched' | 'direct'.
+        Oversize requests (rows > largest bucket) always go direct — the
+        direct path chunks; the batcher never splits a request."""
+        v = self.registry.get(name)                 # -> 404 if unknown
+        try:
+            x = _validate_features(v, features)
+        except ServingError as e:
+            raise ClientError(str(e)) from None
+        use_batch = self.batching if batched is None else bool(batched)
+        path, batcher = "direct", None
+        if use_batch:
+            batcher = self._batcher(name)
+            # route by the BATCHER's own row budget (it may be smaller
+            # than the largest bucket, or stale after a bucket-changing
+            # swap) — oversize requests go direct, which chunks, instead
+            # of bouncing off submit()'s max_batch validation
+            if x.shape[0] <= batcher.max_batch:
+                path = "batched"
+        with self._latency.time(model=name, path=path):
+            if path == "batched":
+                out, version = batcher.submit(x)
+            else:
+                out, version = self.registry.predict(name, x)
+        return out, version, path
+
+    # -- HTTP plumbing ---------------------------------------------------
+    def _make_handler(self):
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _reply(self, code: int, payload, content_type=None,
+                       endpoint="", model=""):
+                if isinstance(payload, (dict, list)):
+                    data = json.dumps(payload).encode()
+                    content_type = content_type or "application/json"
+                else:
+                    data = payload if isinstance(payload, bytes) \
+                        else str(payload).encode()
+                    content_type = content_type or "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                if code >= 400:
+                    # error paths may not have consumed the request body;
+                    # leaving it unread on an HTTP/1.1 keep-alive socket
+                    # desynchronizes every later request on it — close
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(data)
+                srv._requests.inc(model=model, endpoint=endpoint or "other",
+                                  code=str(code))
+
+            def _dispatch(self, method: str):
+                endpoint, model = "other", ""
+                try:
+                    m = _MODEL_PATH.match(self.path)
+                    if self.path == "/healthz" and method == "GET":
+                        endpoint = "healthz"
+                        self._reply(200, srv.health(), endpoint=endpoint)
+                    elif self.path == "/metrics" and method == "GET":
+                        endpoint = "metrics"
+                        self._reply(
+                            200, srv.registry.metrics.prometheus_text(),
+                            content_type=(
+                                "text/plain; version=0.0.4; charset=utf-8"),
+                            endpoint=endpoint)
+                    elif self.path == "/v1/models" and method == "GET":
+                        endpoint = "models"
+                        self._reply(200, {"models": srv.registry.models()},
+                                    endpoint=endpoint)
+                    elif m and m.group(2) is None and method == "GET":
+                        endpoint, model = "model", m.group(1)
+                        self._reply(200, srv.registry.get(model).info(),
+                                    endpoint=endpoint, model=model)
+                    elif m and m.group(2) == "predict" and method == "POST":
+                        endpoint, model = "predict", m.group(1)
+                        body = parse_json_body(self)
+                        out, version, path = srv.predict(
+                            model, require(body, "features"),
+                            batched=body.get("batched"))
+                        self._reply(200, {"model": model,
+                                          "version": version,
+                                          "batched": path == "batched",
+                                          "output": out.tolist()},
+                                    endpoint=endpoint, model=model)
+                    elif m and m.group(2) == "swap" and method == "POST":
+                        endpoint, model = "swap", m.group(1)
+                        body = parse_json_body(self)
+                        try:
+                            v = srv.registry.swap(
+                                model, require(body, "source"),
+                                precision=body.get("precision"),
+                                buckets=body.get("buckets"),
+                                input_shape=body.get("input_shape"))
+                        except (TypeError, ValueError) as e:
+                            # non-numeric buckets/input_shape etc. are
+                            # the client's mistake, not a server fault
+                            raise ClientError(
+                                f"invalid swap parameters: {e}") from None
+                        self._reply(200, v.info(), endpoint=endpoint,
+                                    model=model)
+                    else:
+                        self._reply(404, {"error": f"unknown path "
+                                          f"{method} {self.path}"},
+                                    endpoint=endpoint, model=model)
+                except UnknownModelError as e:
+                    self._reply(404, {"error": f"unknown model "
+                                      f"{e.args[0]!r}"},
+                                endpoint=endpoint, model=model)
+                except (ClientError, ServingError) as e:
+                    self._reply(400, {"error": str(e)},
+                                endpoint=endpoint, model=model)
+                except (BatcherClosedError, TimeoutError) as e:
+                    self._reply(503, {"error": str(e)},
+                                endpoint=endpoint, model=model)
+                except Exception as e:   # genuine server fault
+                    self._reply(500, {"error":
+                                      f"{type(e).__name__}: {e}"},
+                                endpoint=endpoint, model=model)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        return Handler
+
+    def health(self) -> Dict:
+        return {"status": "ok",
+                "models": {n: self.registry.get(n).version
+                           for n in self.registry.names()},
+                "uptime_s": round(time.time() - self._started_at, 3)}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dl4j-serving-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop accepting connections, then drain batchers (accepted
+        requests finish). The _stopping flag closes the race where an
+        in-flight handler would lazily recreate a batcher after the
+        drain pass."""
+        self._stopping = True
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever — calling it when
+            # the serve thread never started blocks forever
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.stop(drain=True)
+        self._httpd.server_close()
+        self._thread = None
+
+
+def _smoke() -> int:
+    """End-to-end smoke for CI (`runtests.sh serving`): ephemeral port,
+    register, predict (batched + direct), hot-swap, scrape /metrics,
+    clean shutdown. Prints PASS/FAIL, returns an exit code."""
+    import tempfile
+    import urllib.request
+
+    from ..models.zoo import mlp_mnist
+    from ..util.serializer import ModelSerializer
+
+    def http(method, url, body=None, timeout=60):
+        req = urllib.request.Request(
+            url, None if body is None else json.dumps(body).encode(),
+            {"Content-Type": "application/json"}, method=method)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            data = resp.read()
+            return json.loads(data) if "json" in ct else data.decode()
+
+    srv = InferenceServer().start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        model = mlp_mnist(seed=3).init()
+        srv.registry.register("mnist", model, buckets=(1, 8))
+        x = np.zeros((3, 784), np.float32).tolist()
+        out = http("POST", f"{base}/v1/models/mnist/predict",
+                   {"features": x})
+        assert np.asarray(out["output"]).shape == (3, 10), out
+        assert out["version"] == 1 and out["batched"], out
+        with tempfile.TemporaryDirectory() as d:
+            ckpt = f"{d}/swap.zip"
+            ModelSerializer.write_model(mlp_mnist(seed=4).init(), ckpt)
+            info = http("POST", f"{base}/v1/models/mnist/swap",
+                        {"source": ckpt})
+        assert info["version"] == 2, info
+        out = http("POST", f"{base}/v1/models/mnist/predict",
+                   {"features": x, "batched": False})
+        assert out["version"] == 2 and not out["batched"], out
+        metrics = http("GET", f"{base}/metrics")
+        for family in ("dl4j_serving_requests_total",
+                       "dl4j_serving_swaps_total",
+                       "dl4j_serving_latency_seconds"):
+            assert family in metrics, f"{family} missing from /metrics"
+        health = http("GET", f"{base}/healthz")
+        assert health["status"] == "ok" and health["models"] == {"mnist": 2}
+        print("serving smoke: PASS "
+              f"(predict+swap+metrics on http://{srv.host}:{srv.port})")
+        return 0
+    except AssertionError as e:
+        print(f"serving smoke: FAIL — {e}")
+        return 1
+    finally:
+        srv.stop()
+
+
+def main(argv=None):
+    """`python -m deeplearning4j_tpu.serving.server --port 8999`
+    (`--smoke` runs the CI end-to-end check and exits)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="deeplearning4j_tpu.serving.server")
+    ap.add_argument("--port", type=int, default=8999)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--no-batching", action="store_true")
+    ap.add_argument("--model", action="append", default=[], metavar
+                    ="NAME=SOURCE", help="register NAME from SOURCE "
+                    "(checkpoint zip/dir or keras h5) at startup")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the CI smoke (ephemeral port) and exit")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        raise SystemExit(_smoke())
+    srv = InferenceServer(host=args.host, port=args.port,
+                          batching=not args.no_batching)
+    for spec in args.model:
+        name, _, source = spec.partition("=")
+        if not source:
+            raise SystemExit(f"--model expects NAME=SOURCE, got {spec!r}")
+        v = srv.registry.register(name, source)
+        print(f"registered '{name}' v{v.version} from {source} "
+              f"(buckets {list(v.buckets)}, {v.precision})")
+    srv.start()
+    print(f"inference server on http://{srv.host}:{srv.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

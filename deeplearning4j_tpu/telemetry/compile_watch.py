@@ -120,6 +120,14 @@ class CompileWatcher:
                 "tail (ArrayDataSetIterator(drop_last=True))",
                 RecompilationStormWarning, stacklevel=3)
 
+    def record_aot(self, name: str, wall_s: float, n: int = 1):
+        """Record an ahead-of-time lower+compile (serving registration,
+        precompiled executables) under `name`. AOT compiles never show up
+        as jit-cache growth — the executable is built before any call —
+        so the builder reports them explicitly; counts and storm warnings
+        then cover jit and AOT entry points uniformly."""
+        self._record(name, n, wall_s)
+
     def count(self, name: str) -> int:
         with self._lock:
             return self._counts.get(name, 0)

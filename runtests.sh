@@ -11,8 +11,16 @@
 #   ./runtests.sh fault      fault-tolerance smoke only (crash-safe
 #                            checkpoints, kill-mid-save recovery, resume
 #                            equivalence, TrainingGuard policies)
+#   ./runtests.sh serving    serving smoke: unit/HTTP tests plus a live
+#                            end-to-end pass (ephemeral port, predict,
+#                            hot-swap, /metrics scrape, clean shutdown)
 set -euo pipefail
 cd "$(dirname "$0")"
+if [[ "${1:-}" == "serving" ]]; then
+    echo "=== serving smoke ==="
+    python -m pytest tests/test_serving.py -q
+    exec python -m deeplearning4j_tpu.serving.server --smoke
+fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="
     exec python -m pytest tests/test_fault.py -q
