@@ -251,6 +251,28 @@ def main():
                     "t8": ab.get("tn_sgd_rep_ms")}
                 extras["DP-replicated-updater-cost-ms"] = ab.get(
                     "replicated_updater_cost_ms")
+            za = sc.get("zero_ablation") or {}
+            if "efficiency_zero" in za:
+                # ZeRO sharded-optimizer ablation (ROADMAP item 2):
+                # strong scaling with the replicated-updater tax removed,
+                # plus what the updater phase still costs after sharding
+                # and the step-time recovered vs the paired replicated
+                # windows
+                extras["DP-strong-scaling-8dev-zero1"] = za[
+                    "efficiency_zero"]
+                extras["DP-strong-scaling-8dev-zero1-paired"] = za.get(
+                    "efficiency_zero_paired")
+                extras["DP-strong-scaling-8dev-zero1-spread"] = za.get(
+                    "efficiency_zero_spread")
+                extras["DP-zero-updater-cost-ms"] = za.get(
+                    "zero_updater_cost_ms")
+                extras["DP-zero-saving-vs-replicated-ms"] = za.get(
+                    "updater_saving_vs_replicated_ms")
+                extras["DP-zero-phases-8dev-ms"] = za.get(
+                    "phases_ndev_zero_ms")
+                extras["DP-t-rep-zero-ms"] = za.get("rep_ms")
+            if sc.get("multichip"):
+                extras["DP-zero-multichip-gate"] = sc["multichip"]
     except Exception:
         pass
     try:

@@ -109,6 +109,13 @@ class TrainingGuard:
     def _restore(self, model, snap):
         for a, v in snap.items():
             setattr(model, a, _copy_val(v))
+        # rollback rewinds counters like iteration_count, so any derived
+        # state keyed on them (ParallelTrainer's per-step eval-view
+        # caches) would otherwise serve pre-rollback values at a reused
+        # key — let the model-like drop it
+        hook = getattr(model, "_fault_restored", None)
+        if hook is not None:
+            hook()
 
     # ------------------------------------------------------------------
     # per-batch stepping

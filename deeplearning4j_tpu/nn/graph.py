@@ -354,38 +354,47 @@ class ComputationGraph:
                                        lmasks=lmasks)
             if not self.conf.conf.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
-            new_params, new_opt = {}, {}
-            for name, p in params.items():
-                layer = self.conf.vertices[name]
-                g, os = grads[name], opt_state[name]
-                if not p or layer.frozen:
-                    new_params[name] = p
-                    new_opt[name] = os
-                    continue
-                g = apply_gradient_normalization(
-                    layer.gradient_normalization,
-                    layer.gradient_normalization_threshold or 1.0, g)
-                upd = self._layer_updater(layer)
-                lr = self._layer_lr(layer, step)
-                updates, os = upd.update(g, os, step, lr)
-                if getattr(layer, "bias_learning_rate", None) is not None:
-                    # same bias-lr rescale as the multilayer step (updater
-                    # steps are linear in lr, so rescaling is exact)
-                    from .multilayer import _rescale_bias_updates
-                    if lr is None:
-                        eff = getattr(upd, "learning_rate", 1.0) or 1.0
-                        scale = layer.bias_learning_rate / eff
-                    else:
-                        scale = layer.bias_learning_rate / jnp.maximum(
-                            jnp.asarray(lr, jnp.float32), 1e-30)
-                    updates = _rescale_bias_updates(updates, scale)
-                # tree-wise: vertex params may be nested dicts (BiLSTM)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda a, u: a - u, p, updates)
-                new_opt[name] = os
+            new_params, new_opt = self.apply_vertex_updates(
+                params, grads, opt_state, step)
             return new_params, new_state, new_opt, score
 
         return train_step
+
+    def apply_vertex_updates(self, params, grads, opt_state, step):
+        """Apply per-vertex updaters to the gradient tree — the update
+        half of the train step, shared with the ZeRO sharded-optimizer
+        step (parallel/zero.py), which reduces the gradients itself and
+        needs only the update applied. Pure/traceable."""
+        new_params, new_opt = {}, {}
+        for name, p in params.items():
+            layer = self.conf.vertices[name]
+            g, os = grads[name], opt_state[name]
+            if not p or layer.frozen:
+                new_params[name] = p
+                new_opt[name] = os
+                continue
+            g = apply_gradient_normalization(
+                layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0, g)
+            upd = self._layer_updater(layer)
+            lr = self._layer_lr(layer, step)
+            updates, os = upd.update(g, os, step, lr)
+            if getattr(layer, "bias_learning_rate", None) is not None:
+                # same bias-lr rescale as the multilayer step (updater
+                # steps are linear in lr, so rescaling is exact)
+                from .multilayer import _rescale_bias_updates
+                if lr is None:
+                    eff = getattr(upd, "learning_rate", 1.0) or 1.0
+                    scale = layer.bias_learning_rate / eff
+                else:
+                    scale = layer.bias_learning_rate / jnp.maximum(
+                        jnp.asarray(lr, jnp.float32), 1e-30)
+                updates = _rescale_bias_updates(updates, scale)
+            # tree-wise: vertex params may be nested dicts (BiLSTM)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda a, u: a - u, p, updates)
+            new_opt[name] = os
+        return new_params, new_opt
 
     def _layer_lr(self, layer, step):
         sched = self.conf.conf.lr_schedule

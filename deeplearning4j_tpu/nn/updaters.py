@@ -49,6 +49,16 @@ class Updater:
 
     learning_rate: float = 0.1
 
+    #: ZeRO contract (parallel/zero.py): True means `update` is elementwise
+    #: over each tensor — the update of a SHARD of (grads, state) equals the
+    #: same shard of the full update, so partitioning optimizer state over
+    #: the data axis is communication-free. Every built-in updater is
+    #: elementwise; a future cross-element updater (LAMB's per-layer trust
+    #: ratio, Shampoo preconditioners) must set this False so the ZeRO
+    #: strategies refuse it up front instead of silently re-gathering
+    #: inside the step.
+    elementwise_state = True
+
     def init(self, params) -> Any:
         return ()
 

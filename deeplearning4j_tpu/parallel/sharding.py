@@ -33,6 +33,15 @@ class ShardingStrategy:
     TENSOR_PARALLEL = "tensor_parallel"
     FSDP = "fsdp"
     PIPELINE = "pipeline"  # stage-partitioned layers (PipelinedNetworkTrainer)
+    # ZeRO data parallelism (zero.py): params stay REPLICATED between
+    # steps; optimizer moments (and, for ZERO2, the reduced gradients
+    # inside the step) are sharded over the data axis
+    ZERO1 = "zero1"
+    ZERO2 = "zero2"
+
+    #: strategies under which every device holds the full params between
+    #: steps (evaluation/scoring may pull a host-local copy safely)
+    PARAMS_REPLICATED = (REPLICATED, ZERO1, ZERO2)
 
 
 def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh):
@@ -70,7 +79,9 @@ def param_specs(params, strategy: str, mesh: Mesh,
                 data_axis: str = MeshAxes.DATA):
     """PartitionSpec pytree matching `params` (a MultiLayerNetwork tuple-of-
     dicts or ComputationGraph dict-of-dicts)."""
-    if strategy == ShardingStrategy.REPLICATED:
+    if strategy in ShardingStrategy.PARAMS_REPLICATED:
+        # ZeRO strategies shard OPTIMIZER state (zero.zero_opt_shardings),
+        # not the params themselves
         return jax.tree_util.tree_map(lambda a: P(), params)
     if strategy == ShardingStrategy.TENSOR_PARALLEL:
         def spec(path, leaf):

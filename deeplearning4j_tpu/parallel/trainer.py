@@ -23,7 +23,11 @@ allreduce under jit) — updater state optionally averaged too
 (`averageUpdatersState` parity).
 
 Tensor-parallel / FSDP param shardings compose with SYNC mode via
-`strategy=` (see `sharding.py`).
+`strategy=` (see `sharding.py`). `ShardingStrategy.ZERO1`/`ZERO2` keep
+params replicated but shard optimizer state (and stage-2 reduced
+gradients) over the data axis — reduce-scatter -> sharded update ->
+allgather instead of allreduce -> replicated update (see `zero.py`),
+killing the replicated-updater tax BENCH_r05 measured at ~2.3 s/step.
 """
 from __future__ import annotations
 
@@ -55,6 +59,43 @@ def _to_host(tree):
         lambda a: jnp.asarray(np.asarray(a)), tree)
 
 
+#: supported mode × strategy combinations, validated up front in __init__
+#: (AVERAGING keeps an independent full replica per device, so every
+#: sharded strategy is out; SYNC composes with all of them)
+_MODE_STRATEGIES = {
+    TrainingMode.SYNC: (
+        ShardingStrategy.REPLICATED, ShardingStrategy.TENSOR_PARALLEL,
+        ShardingStrategy.FSDP, ShardingStrategy.ZERO1,
+        ShardingStrategy.ZERO2, ShardingStrategy.PIPELINE),
+    TrainingMode.AVERAGING: (ShardingStrategy.REPLICATED,),
+}
+
+
+def _validate_mode_strategy(mode: str, strategy: str) -> None:
+    """One actionable error for every unsupported (mode, strategy) pair —
+    raised before any mesh/model work instead of deep inside _prepare."""
+    pairs = "; ".join(
+        f"{m}: {', '.join(s)}" for m, s in sorted(_MODE_STRATEGIES.items()))
+    if mode not in _MODE_STRATEGIES:
+        raise ValueError(
+            f"unknown training mode '{mode}'. Supported mode -> "
+            f"strategies: {pairs}")
+    if strategy not in _MODE_STRATEGIES[TrainingMode.SYNC]:
+        raise ValueError(
+            f"unknown sharding strategy '{strategy}'. Supported mode -> "
+            f"strategies: {pairs}")
+    if strategy not in _MODE_STRATEGIES[mode]:
+        hint = ""
+        if mode == TrainingMode.AVERAGING:
+            hint = (" — parameter averaging needs every device to hold an "
+                    "independent FULL replica; use TrainingMode.SYNC for "
+                    "sharded strategies (tensor_parallel/fsdp/zero1/zero2/"
+                    "pipeline)")
+        raise ValueError(
+            f"mode={mode} does not support strategy='{strategy}'{hint}. "
+            f"Supported mode -> strategies: {pairs}")
+
+
 class ParallelTrainer:
     """fit(iterator) over a device mesh.
 
@@ -68,6 +109,13 @@ class ParallelTrainer:
     _fault_state_attrs = ("_params", "_state", "_opt", "_rng",
                           "iteration_count", "_score")
 
+    def _fault_restored(self):
+        """TrainingGuard rollback hook: the restore rewinds
+        iteration_count, so the per-step eval-view caches keyed on it
+        could serve pre-rollback params at a reused key — drop them."""
+        self._host_cache = None
+        self._eval_cache = None
+
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: str = TrainingMode.SYNC,
                  strategy: str = ShardingStrategy.REPLICATED,
@@ -75,7 +123,19 @@ class ParallelTrainer:
                  average_updaters: bool = True,
                  data_axis: str = MeshAxes.DATA,
                  model_axis: str = MeshAxes.MODEL,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False,
+                 zero_bucket_mb: Optional[float] = None,
+                 zero_reduce_dtype: Optional[str] = None):
+        _validate_mode_strategy(mode, strategy)
+        if (strategy not in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2)
+                and (zero_bucket_mb is not None
+                     or zero_reduce_dtype is not None)):
+            # silently ignoring the knobs would let a user believe they
+            # enabled bucketing / the bf16 wire on a step that has neither
+            raise ValueError(
+                "zero_bucket_mb/zero_reduce_dtype only apply to the ZeRO "
+                f"strategies (zero1/zero2); strategy='{strategy}' ignores "
+                "them — drop the knobs or switch strategy")
         if model.params is None:
             model.init()
         self.model = model
@@ -93,6 +153,15 @@ class ParallelTrainer:
             self.stats = TrainingStats()
         self.data_axis = data_axis
         self.model_axis = model_axis
+        # ZeRO knobs (strategy zero1/zero2): gradient bucket size bound
+        # (None = zero.DEFAULT_BUCKET_MB) and the optional narrow wire
+        # dtype for the stage-2 reduction
+        self.zero_bucket_mb = (None if zero_bucket_mb is None
+                               else float(zero_bucket_mb))
+        self.zero_reduce_dtype = zero_reduce_dtype
+        self._zero_info = None
+        self._host_cache = None
+        self._eval_cache = None
         if strategy == ShardingStrategy.PIPELINE:
             # stage-partitioned training of a real MultiLayerNetwork: the
             # mesh must carry a "pipe" axis; delegate to the GPipe trainer
@@ -112,8 +181,6 @@ class ParallelTrainer:
             return
         self._pipe = None
         self.n_data = self.mesh.shape[data_axis]
-        if mode == TrainingMode.AVERAGING and strategy != ShardingStrategy.REPLICATED:
-            raise ValueError("averaging mode requires replicated params")
         if mode == TrainingMode.AVERAGING and jax.process_count() > 1:
             # the multi-host dataset plane (global_batch_array assembly)
             # only exists for SYNC; AVERAGING would hand host-local arrays
@@ -137,7 +204,43 @@ class ParallelTrainer:
         self._repl = repl
         self._batch_sh = batch_sh
         self._p_sh = repl
-        if self.mode == TrainingMode.SYNC:
+        if self.mode == TrainingMode.SYNC and self.strategy in (
+                ShardingStrategy.ZERO1, ShardingStrategy.ZERO2):
+            # ZeRO: params replicated between steps, optimizer moments
+            # sharded over the data axis; the step reduce-scatters grads
+            # (stage 2), updates only the local shard and allgathers the
+            # new params via the replicated out-sharding. Buffers donate
+            # end-to-end exactly like the replicated step.
+            from .zero import (DEFAULT_BUCKET_MB, ZeroConfig, make_zero_step,
+                               zero_opt_shardings)
+            cfg = ZeroConfig(
+                stage=1 if self.strategy == ShardingStrategy.ZERO1 else 2,
+                bucket_mb=(DEFAULT_BUCKET_MB if self.zero_bucket_mb is None
+                           else self.zero_bucket_mb),
+                reduce_dtype=self.zero_reduce_dtype)
+            step_fn, self._zero_info = make_zero_step(
+                m, mesh, data_axis=self.data_axis, config=cfg)
+            o_sh = zero_opt_shardings(m.updater_state, m.params, mesh,
+                                      self.data_axis)
+            self._p_sh = repl
+            self._params = jax.device_put(m.params, repl)
+            self._state = jax.device_put(m.state, repl)
+            if jax.process_count() > 1:
+                # device_put of a host tree onto a NON-fully-addressable
+                # sharded layout needs a cross-process equality check the
+                # CPU backend lacks; place replicated, then let an SPMD
+                # identity slice each process's shards out
+                opt = jax.device_put(m.updater_state, repl)
+                self._opt = jax.jit(lambda t: t, out_shardings=o_sh)(opt)
+            else:
+                self._opt = jax.device_put(m.updater_state, o_sh)
+            self._step_fn = watch_compiles(jax.jit(
+                step_fn,
+                in_shardings=(repl, repl, o_sh, repl, batch_sh, batch_sh,
+                              repl, batch_sh, batch_sh),
+                out_shardings=(repl, repl, o_sh, repl),
+                donate_argnums=(0, 1, 2)), "parallel/zero_step")
+        elif self.mode == TrainingMode.SYNC:
             specs = param_specs(m.params, self.strategy, mesh,
                                 self.model_axis, self.data_axis)
             p_sh = jax.tree_util.tree_map(
@@ -217,6 +320,11 @@ class ParallelTrainer:
 
         self.iteration_count = 0
         self._score = float("nan")
+        # evaluation-view caches (per trained step; see _host_view). Reset
+        # here because a checkpoint restore re-prepares with NEW params at
+        # a possibly-identical iteration count
+        self._host_cache = None
+        self._eval_cache = None
         self._rng = m._rng if getattr(m, "_rng", None) is not None else \
             jax.random.PRNGKey(0)
 
@@ -372,6 +480,8 @@ class ParallelTrainer:
                         self._params, self._state, self._opt, step,
                         xd, yd, rng, fm, lm)
                 self._score = score
+                if tel is not None and self._zero_info is not None:
+                    self._record_zero_metrics(tel)
                 if self.stats is not None or (tel is not None
                                               and tel.sync_per_step):
                     with span("device/sync"):
@@ -402,6 +512,41 @@ class ParallelTrainer:
         if tel is not None and self.iteration_count % tel.report_window == 0:
             # per-device watermarks over THIS trainer's mesh
             tel.watermarks.sample(devices=list(self.mesh.devices.flat))
+
+    def _record_zero_metrics(self, tel):
+        """Per-step ZeRO collective-traffic counters (static per-step
+        accounting from make_zero_step):
+          dl4j_collective_bytes_total{op}   logical payload bytes by
+                                            collective op
+          dl4j_dp_bucket_flushes_total      gradient bucket reduce-scatter
+                                            flushes (stage 2)
+        Counters are get-or-create against the active session's registry,
+        cached until the session changes."""
+        cached = getattr(self, "_zero_metrics", None)
+        if cached is None or cached[0] is not tel:
+            reg = tel.registry
+            cached = (tel,
+                      reg.counter("dl4j_collective_bytes_total",
+                                  "logical payload bytes moved by "
+                                  "data-parallel collectives",
+                                  labels=("op",)),
+                      reg.counter("dl4j_dp_bucket_flushes_total",
+                                  "gradient bucket reduce-scatter flushes"))
+            self._zero_metrics = cached
+        _, c_bytes, c_flush = cached
+        info = self._zero_info
+        for op, b in info["bytes"].items():
+            if b:
+                c_bytes.inc(b, op=op)
+        if info["n_buckets"]:
+            c_flush.inc(info["n_buckets"])
+
+    @property
+    def params_replicated(self) -> bool:
+        """True when every device holds the FULL params between steps —
+        REPLICATED and the ZeRO strategies (which shard optimizer state,
+        not params). Host-local evaluation paths are only sound then."""
+        return self.strategy in ShardingStrategy.PARAMS_REPLICATED
 
     def score(self, ds=None) -> float:
         """No-arg: last minibatch training score (reference ParallelWrapper
@@ -452,16 +597,16 @@ class ParallelTrainer:
         # ragged batch: the scalar is a mean over REAL rows only, so the
         # pad-and-slice trick doesn't apply — score host-local instead.
         # Only sound with replicated params (they fit one device by
-        # definition); materializing a SHARDED model on one device could
-        # OOM the very model the sharding exists for (review r5)
-        if self.strategy != ShardingStrategy.REPLICATED:
+        # definition; ZeRO qualifies — only its OPT state is sharded);
+        # materializing a SHARDED model on one device could OOM the very
+        # model the sharding exists for (review r5)
+        if not self.params_replicated:
             raise ValueError(
                 f"score(ds) with strategy={self.strategy} needs a batch "
                 f"divisible by the data axis ({self.n_data}); got {bs}. "
                 "Pad or re-batch the validation set")
-        params, state = self._eval_params_state()
-        return float(self._score_raw(_to_host(params), _to_host(state),
-                                     x, y, fm, lm))
+        params, state = self._host_view()
+        return float(self._score_raw(params, state, x, y, fm, lm))
 
     def _reg_value(self, params) -> float:
         """Full-network l1/l2 penalty (identical on every process — params
@@ -516,12 +661,38 @@ class ParallelTrainer:
     # ------------------------------------------------------------------
     def _eval_params_state(self):
         if self.mode == TrainingMode.SYNC:
+            # live refs — no gather, no copy (the eval jits carry the
+            # training shardings, so sharded strategies evaluate SPMD
+            # without ever materializing the full tree)
             return self._params, self._state
         # AVERAGING: same view _sync_back publishes — params averaged over
-        # replicas, state from replica 0
+        # replicas, state from replica 0. The mean is DERIVED work, so it
+        # is cached per trained step: a multi-batch validation pass (early
+        # stopping, evaluate over an iterator) computes it once, not once
+        # per batch; the next fit step invalidates via iteration_count
+        cached = self._eval_cache
+        if cached is not None and cached[0] == self.iteration_count:
+            return cached[1], cached[2]
         tmap = jax.tree_util.tree_map
-        return (tmap(lambda a: a.mean(0), self._params),
-                tmap(lambda a: a[0], self._state))
+        params = tmap(lambda a: a.mean(0), self._params)
+        state = tmap(lambda a: a[0], self._state)
+        self._eval_cache = (self.iteration_count, params, state)
+        return params, state
+
+    def _host_view(self):
+        """Host-local gathered copy of (params, state) for the host-side
+        scoring/eval paths, cached per trained step — repeated score()/
+        evaluate() calls between fit steps pull the model device-to-host
+        ONCE instead of re-gathering per call (the next fit step advances
+        iteration_count, invalidating the cache; _prepare clears it on
+        checkpoint restore)."""
+        cached = self._host_cache
+        if cached is not None and cached[0] == self.iteration_count:
+            return cached[1], cached[2]
+        params, state = self._eval_params_state()
+        params, state = _to_host(params), _to_host(state)
+        self._host_cache = (self.iteration_count, params, state)
+        return params, state
 
     @functools.cached_property
     def _eval_predict(self):
@@ -749,20 +920,16 @@ class ParallelTrainer:
     def _local_params_state(self):
         """Host-local copy of the trained params for per-process map-side
         evaluation (requires replicated params — every host holds the full
-        value, like every Spark executor held the broadcast params).
-        Cached per training step: a multi-batch validation pass pulls the
-        model device-to-host once, not once per batch (review r5)."""
-        if self.strategy != ShardingStrategy.REPLICATED:
+        value, like every Spark executor held the broadcast params; the
+        ZeRO strategies qualify, their params are replicated between
+        steps). Cached per training step via _host_view: a multi-batch
+        validation pass pulls the model device-to-host once, not once per
+        batch (review r5)."""
+        if not self.params_replicated:
             raise ValueError(
                 "multi-process evaluate/score needs replicated params; "
                 f"strategy={self.strategy} shards them across hosts")
-        cached = getattr(self, "_host_cache", None)
-        if cached is not None and cached[0] == self.iteration_count:
-            return cached[1], cached[2]
-        params, state = self._eval_params_state()
-        params, state = _to_host(params), _to_host(state)
-        self._host_cache = (self.iteration_count, params, state)
-        return params, state
+        return self._host_view()
 
     def _local_predict(self, params, state, ds):
         x, _, fm, _ = self._to_batch(ds)

@@ -1,0 +1,326 @@
+"""ZeRO-style sharded data parallelism (stages 1 and 2).
+
+The plain SYNC data-parallel step pays a "replicated updater" tax: every
+device holds the FULL optimizer state and redundantly applies the FULL
+parameter update after the gradient allreduce (BENCH_r05 attributes
+~2.3 s/step of the 8-device Adam wall time to exactly this,
+`DP-replicated-updater-cost-ms`). ZeRO (Rajbhandari et al., 2020) removes
+it by partitioning optimizer state — and, at stage 2, the reduced
+gradients — across the data-parallel axis:
+
+  reduce(-scatter) grads  ->  each device updates only ITS shard of the
+  moments and params      ->  allgather of the updated params
+
+Expressed GSPMD-natively here: optimizer moments are device_put with
+FSDP-style PartitionSpecs over the ``data`` axis (`zero_opt_shardings`),
+the step constrains the updated params (and, for ZERO2, the gradients) to
+those same specs with `with_sharding_constraint`, and the jit's replicated
+out-sharding for params becomes the trailing allgather. XLA then partitions
+the elementwise updater math 1/N per device and fuses the collectives —
+the reduce-scatter of a late-layer gradient bucket is issued as soon as
+backward produces it, overlapping with the remaining backward compute
+(PyTorch DDP's bucketing design, Li et al., 2020, made explicit for the
+XLA scheduler by the per-bucket flush chain below).
+
+Stage semantics:
+  * ZERO1 — optimizer state sharded. Gradients are fully reduced (the
+    familiar allreduce; every device still sees full grads, so per-tensor
+    gradient-normalization modes read whole tensors locally), the update
+    runs sharded, params are allgathered.
+  * ZERO2 — + gradient partitioning: gradients are packed into
+    size-bounded buckets (reverse layer order ≈ backward production
+    order) and each bucket is reduce-scattered; no device ever
+    materializes the full replicated gradient tree. `reduce_dtype`
+    ("bfloat16") optionally narrows the wire format of that reduction
+    while the master update stays in the gradient/param dtype (fp32).
+
+Both stages keep params replicated between steps, so evaluation, scoring,
+early stopping and checkpointing see an ordinary replicated model; only
+`updater_state` is mesh-sharded (orbax writes it shard-wise through
+`parallel/checkpoint.py`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MeshAxes
+from .sharding import _fsdp_spec_for, _opt_sharding_like
+
+__all__ = ["ZeroConfig", "assign_buckets", "make_zero_step",
+           "zero_grad_specs", "zero_opt_shardings"]
+
+DEFAULT_BUCKET_MB = 4.0
+
+
+@dataclass(frozen=True)
+class ZeroConfig:
+    """Knobs for the ZeRO step.
+
+    stage         1 (shard optimizer state) or 2 (+ shard reduced grads).
+    bucket_mb     gradient-bucket size bound in MiB (stage 2). Smaller
+                  buckets overlap earlier but issue more collectives;
+                  DDP's classic default is 25 MB, small CPU-mesh models
+                  want less.
+    reduce_dtype  optional wire dtype for the stage-2 gradient reduction
+                  (e.g. "bfloat16"). The updater math — the fp32 master
+                  update — always runs in the original gradient dtype.
+    ordered_flush chain bucket reduce-scatters in production order with
+                  optimization_barrier so XLA cannot collapse them into
+                  one monolithic end-of-backward collective.
+    """
+
+    stage: int = 1
+    bucket_mb: float = DEFAULT_BUCKET_MB
+    reduce_dtype: Optional[str] = None
+    ordered_flush: bool = True
+
+
+def _is_p(x) -> bool:
+    return isinstance(x, P)
+
+
+def _nontrivial(spec: P) -> bool:
+    return any(ax is not None for ax in tuple(spec))
+
+
+def zero_grad_specs(params, mesh: Mesh, data_axis: str = MeshAxes.DATA):
+    """Per-leaf PartitionSpec pytree sharding each gradient/moment tensor
+    on its largest data-axis-divisible dimension (biases and other tensors
+    with no divisible axis stay replicated — their update cost is noise)."""
+    return jax.tree_util.tree_map(
+        lambda a: _fsdp_spec_for(np.shape(a), data_axis, mesh), params)
+
+
+def zero_opt_shardings(opt_state, params, mesh: Mesh,
+                       data_axis: str = MeshAxes.DATA):
+    """NamedSharding pytree for the optimizer state: each moment tensor
+    gets its param's ZeRO shard spec (matched by shape), scalars and
+    unmatched leaves replicated."""
+    specs = zero_grad_specs(params, mesh, data_axis)
+    p_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_p)
+    return _opt_sharding_like(opt_state, params, p_sh)
+
+
+def assign_buckets(sizes: Sequence[int], bucket_bytes: int
+                   ) -> List[List[int]]:
+    """Greedy, order-preserving pack of leaf indices into size-bounded
+    buckets. `sizes` must already be in gradient PRODUCTION order (the
+    caller reverses the forward layer order). A leaf larger than the bound
+    gets a bucket of its own; every index lands in exactly one bucket."""
+    cap = max(1, int(bucket_bytes))
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_b = 0
+    for i, b in enumerate(sizes):
+        b = int(b)
+        if cur and cur_b + b > cap:
+            buckets.append(cur)
+            cur, cur_b = [], 0
+        cur.append(i)
+        cur_b += b
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _check_updaters(model):
+    """ZeRO partitions the update elementwise over the data axis; an
+    updater whose state transform is NOT elementwise (a future LAMB trust
+    ratio, Shampoo preconditioner...) would silently re-gather inside the
+    step — refuse it up front instead."""
+    from ..nn.graph import ComputationGraph
+
+    if isinstance(model, ComputationGraph):
+        pairs = [(model.conf.vertices[name], p)
+                 for name, p in model.params.items()]
+    else:
+        pairs = list(zip(model.layers, model.params))
+    for layer, p in pairs:
+        if not p or getattr(layer, "frozen", False):
+            continue
+        upd = model._layer_updater(layer)
+        if not getattr(upd, "elementwise_state", True):
+            raise ValueError(
+                f"updater {type(upd).__name__} declares "
+                "elementwise_state=False — its update cannot be sharded "
+                "over the data axis; use ShardingStrategy.REPLICATED for "
+                "this model")
+
+
+def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
+                   config: ZeroConfig = ZeroConfig()
+                   ) -> Tuple[Any, Dict[str, Any]]:
+    """Build the ZeRO train step for `model` (MultiLayerNetwork or
+    ComputationGraph).
+
+    Returns (step_fn, info): `step_fn` has the exact signature of the
+    model's `train_step_fn` — (params, state, opt_state, step, x, y, rng,
+    fmask, lmask) -> (params, state, opt_state, score) — for the trainer
+    to jit with replicated params in/out (the out-sharding IS the ZeRO
+    allgather), sharded opt state (`zero_opt_shardings`) and donated
+    buffers. `info` carries the static per-step accounting the trainer
+    feeds telemetry: logical collective payload bytes by op and the
+    gradient bucket count.
+    """
+    from ..nn.graph import ComputationGraph
+
+    if config.stage not in (1, 2):
+        raise ValueError(f"ZeRO stage must be 1 or 2, got {config.stage}")
+    if config.stage == 1 and config.reduce_dtype is not None:
+        # silently ignoring the knob would let a user believe they halved
+        # the wire payload; only stage 2 owns the gradient reduction
+        raise ValueError(
+            "reduce_dtype (zero_reduce_dtype=) only applies to ZERO2 — "
+            "stage 1 reduces gradients in their own dtype; use "
+            "ShardingStrategy.ZERO2 or drop the knob")
+    _check_updaters(model)
+    is_graph = isinstance(model, ComputationGraph)
+
+    # ---- static layout: one spec/sharding per param leaf ----------------
+    leaves, treedef = jax.tree_util.tree_flatten(model.params)
+    specs = jax.tree_util.tree_leaves(
+        zero_grad_specs(model.params, mesh, data_axis), is_leaf=_is_p)
+    shardings = [NamedSharding(mesh, s) for s in specs]
+    shapes = [np.shape(l) for l in leaves]
+    counts = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+    itemsize = [np.dtype(jnp.result_type(l)).itemsize for l in leaves]
+    red_itemsize = (np.dtype(config.reduce_dtype).itemsize
+                    if config.reduce_dtype is not None else None)
+
+    # buckets pack the REVERSED leaf order: backward produces the last
+    # layer's gradients first, so reverse-forward order approximates the
+    # order buckets fill in PyTorch DDP
+    order = list(range(len(leaves)))[::-1]
+    wire = lambda i: counts[i] * (red_itemsize or itemsize[i])
+    buckets = [[order[j] for j in b] for b in assign_buckets(
+        [wire(i) for i in order], int(config.bucket_mb * (1 << 20)))]
+
+    sharded_idx = [i for i, s in enumerate(specs) if _nontrivial(s)]
+    sharded_set = set(sharded_idx)
+    rs_bytes = sum(wire(i) for i in sharded_idx)
+    full_bytes = sum(wire(i) for i in range(len(leaves)))
+    ag_bytes = sum(counts[i] * itemsize[i] for i in sharded_idx)
+    info = {
+        "stage": config.stage,
+        "n_buckets": len(buckets) if config.stage >= 2 else 0,
+        "sharded_leaves": len(sharded_idx),
+        "replicated_leaves": len(leaves) - len(sharded_idx),
+        # logical payload per step (what the wire carries, not ×(N-1)/N)
+        "bytes": ({"reduce_scatter": rs_bytes,
+                   "all_reduce": full_bytes - rs_bytes,
+                   "all_gather": ag_bytes}
+                  if config.stage >= 2 else
+                  {"reduce_scatter": 0,
+                   "all_reduce": sum(counts[i] * itemsize[i]
+                                     for i in range(len(leaves))),
+                   "all_gather": ag_bytes}),
+    }
+
+    # optimizer-state constraints (same specs, matched by shape)
+    opt_sh_tree = zero_opt_shardings(model.updater_state, model.params,
+                                     mesh, data_axis)
+    opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
+    opt_treedef = jax.tree_util.tree_structure(model.updater_state)
+
+    # ---- the gradient reduction (stage 2): bucketed reduce-scatter ------
+    def _reduce_scatter(grads):
+        flat = jax.tree_util.tree_leaves(grads)
+        dtypes = [g.dtype for g in flat]
+        out = list(flat)
+        if config.reduce_dtype is not None:
+            rd = jnp.dtype(config.reduce_dtype)
+            out = [g.astype(rd) for g in out]
+        token = None
+        for bucket in buckets:
+            vals = [out[i] for i in bucket]
+            if token is not None and config.ordered_flush:
+                # chain: this bucket's reduction may not be hoisted before
+                # (or merged with) the previous bucket's flush
+                *vals, _ = jax.lax.optimization_barrier(
+                    tuple(vals) + (token,))
+            vals = [jax.lax.with_sharding_constraint(v, shardings[i])
+                    if i in sharded_set else v
+                    for v, i in zip(vals, bucket)]
+            for v, i in zip(vals, bucket):
+                out[i] = v
+            t = vals[0]
+            token = t if t.ndim == 0 else t[(0,) * t.ndim]
+        if config.reduce_dtype is not None:
+            # fp32 master update: widen back after the narrow reduction
+            out = [g.astype(dt) for g, dt in zip(out, dtypes)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _constrain_params(tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        flat = [jax.lax.with_sharding_constraint(v, shardings[i])
+                if i in sharded_set else v
+                for i, v in enumerate(flat)]
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def _constrain_opt(tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        flat = [jax.lax.with_sharding_constraint(v, s)
+                for v, s in zip(flat, opt_sh_leaves)]
+        return jax.tree_util.tree_unflatten(opt_treedef, flat)
+
+    # ---- grad half (mirrors each family's _make_train_step) -------------
+    base_loss = model._loss_fn
+    remat = getattr(model.conf.conf, "remat", None) == "full"
+    minimize = model.conf.conf.minimize
+
+    if is_graph:
+        def grad_fn(params, state, x, y, rng, fm, lm):
+            f = base_loss
+            if remat:
+                f = jax.checkpoint(lambda p, s, x_, y_, r_: base_loss(
+                    p, s, x_, y_, r_, fmasks=fm, lmasks=lm))
+                (score, new_state), grads = jax.value_and_grad(
+                    f, has_aux=True)(params, state, x, y, rng)
+            else:
+                (score, new_state), grads = jax.value_and_grad(
+                    f, has_aux=True)(params, state, x, y, rng,
+                                     fmasks=fm, lmasks=lm)
+            return score, new_state, grads
+    else:
+        def grad_fn(params, state, x, y, rng, fm, lm):
+            f = base_loss
+            if remat:
+                f = jax.checkpoint(lambda p, s, x_, y_, r_: base_loss(
+                    p, s, x_, y_, r_, fmask=fm, lmask=lm))
+                (score, (new_state, _)), grads = jax.value_and_grad(
+                    f, has_aux=True)(params, state, x, y, rng)
+            else:
+                (score, (new_state, _)), grads = jax.value_and_grad(
+                    f, has_aux=True)(params, state, x, y, rng,
+                                     fmask=fm, lmask=lm)
+            return score, new_state, grads
+
+    def step(params, state, opt_state, step_i, x, y, rng, fmask, lmask):
+        score, new_state, grads = grad_fn(params, state, x, y, rng,
+                                          fmask, lmask)
+        if not minimize:
+            grads = jax.tree_util.tree_map(lambda g: -g, grads)
+        if config.stage >= 2:
+            grads = _reduce_scatter(grads)
+        if is_graph:
+            new_params, new_opt = model.apply_vertex_updates(
+                params, grads, opt_state, step_i)
+        else:
+            np_, no_ = model.apply_layer_updates(
+                model.layers, params, grads, opt_state, step_i)
+            new_params, new_opt = tuple(np_), tuple(no_)
+        # each device computes only ITS shard of the new params and
+        # moments; the jit's replicated param out-sharding is then the
+        # trailing ZeRO allgather
+        new_params = _constrain_params(new_params)
+        new_opt = _constrain_opt(new_opt)
+        return new_params, new_state, new_opt, score
+
+    return step, info

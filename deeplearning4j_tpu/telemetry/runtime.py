@@ -143,6 +143,22 @@ class TelemetrySession:
                                   for k, v in sorted(buckets.values().items())}
         return out
 
+    def dp_summary(self) -> Dict:
+        """Data-parallel collective-traffic metrics (parallel/zero.py):
+        logical payload bytes per collective op and gradient bucket
+        flushes. Empty dict when no ZeRO step ran under this session."""
+        out: Dict = {}
+        c = self.registry.get("dl4j_collective_bytes_total")
+        if c is not None and c.values():
+            out["collective_bytes"] = {
+                k[0]: int(v) for k, v in sorted(c.values().items())}
+        f = self.registry.get("dl4j_dp_bucket_flushes_total")
+        if f is not None:
+            n = sum(f.values().values())
+            if n:
+                out["bucket_flushes"] = int(n)
+        return out
+
     def fault_summary(self) -> Dict:
         """Fault-tolerance metrics (fault/): checkpoint save/restore
         counts + wall seconds per kind (zip|sharded), non-finite steps
@@ -182,6 +198,9 @@ class TelemetrySession:
         pipe = self.pipeline_summary()
         if pipe:
             out["pipeline"] = pipe
+        dp = self.dp_summary()
+        if dp:
+            out["dp"] = dp
         fault = self.fault_summary()
         if fault:
             out["fault"] = fault

@@ -14,12 +14,25 @@
 #   ./runtests.sh serving    serving smoke: unit/HTTP tests plus a live
 #                            end-to-end pass (ephemeral port, predict,
 #                            hot-swap, /metrics scrape, clean shutdown)
+#   ./runtests.sh zero       ZeRO sharded-optimizer smoke: the replicated-
+#                            vs-zero1/zero2 equivalence suite on the
+#                            8-device virtual mesh plus one scaling_bench
+#                            rep with the paired replicated-vs-ZeRO
+#                            ablation (prints the efficiency JSON line)
 set -euo pipefail
 cd "$(dirname "$0")"
 if [[ "${1:-}" == "serving" ]]; then
     echo "=== serving smoke ==="
     python -m pytest tests/test_serving.py -q
     exec python -m deeplearning4j_tpu.serving.server --smoke
+fi
+if [[ "${1:-}" == "zero" ]]; then
+    echo "=== ZeRO sharded-optimizer smoke ==="
+    python -m pytest tests/test_zero.py -q
+    exec env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --model mlp --global-batch 64 --steps 2 --reps 1 --no-ablation
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

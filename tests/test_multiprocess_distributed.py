@@ -104,6 +104,28 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(u0, u1, rtol=0, atol=0)
     assert u0.shape == (80,)
 
+    # ZeRO-1 sharded-optimizer smoke: both processes converged to the
+    # SAME replicated params, equal (to fp32 tolerance) to single-process
+    # replicated Adam on the same global batch — reduce-scatter + sharded
+    # update + allgather across the process boundary changes the layout,
+    # not the math
+    z0 = np.load(tmp_path / "params_zero_p0.npy")
+    z1 = np.load(tmp_path / "params_zero_p1.npy")
+    np.testing.assert_allclose(z0, z1, rtol=0, atol=0)
+    from deeplearning4j_tpu import Adam
+    conf_adam = (NeuralNetConfiguration.builder().seed(7)
+                 .updater(Adam(1e-2))
+                 .list()
+                 .layer(DenseLayer(n_out=16, activation="tanh"))
+                 .layer(OutputLayer(n_out=4, loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(8))
+                 .build())
+    single_z = MultiLayerNetwork(conf_adam).init()
+    for _ in range(5):
+        single_z.fit(ds)
+    np.testing.assert_allclose(z0, single_z.params_flat(), rtol=2e-5,
+                               atol=1e-6)
+
     # time-source tier crossed the process boundary: both processes
     # produced offset-corrected stamps on one timeline (same host here,
     # so the stamps must agree within the run's duration)
