@@ -310,6 +310,20 @@ def main():
             }
     except Exception:
         pass
+    try:
+        # graftlint trajectory (ISSUE 9): total/new findings per rule via
+        # the CLI's --metrics machinery (dl4j_lint_findings_total{rule}),
+        # so the burn-down of baselined findings stays visible across PRs
+        from deeplearning4j_tpu.analysis.cli import lint_metrics
+        here = os.path.dirname(os.path.abspath(__file__))
+        lm = lint_metrics([os.path.join(here, "deeplearning4j_tpu")],
+                          baseline=os.path.join(here,
+                                                "graftlint_baseline.json"))
+        extras["Lint-findings"] = {"total": lm["total"], "new": lm["new"],
+                                   "by_rule": lm["by_rule"],
+                                   "wall_s": lm["wall_s"]}
+    except Exception as e:
+        extras["Lint-findings"] = f"error: {type(e).__name__}"
 
     baseline = None
     try:

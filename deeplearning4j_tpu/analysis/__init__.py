@@ -1,0 +1,32 @@
+"""graftlint: JAX-aware static analysis + runtime sanitizers.
+
+The codebase's recurring review-fix classes — host syncs and retrace
+hazards inside jit-reachable code, donated-buffer reuse, and
+lock/lifecycle races in the threaded subsystems — are all statically
+visible in the AST. This package turns them into enforced rules:
+
+  * `engine`      — findings, pragma suppression, baseline workflow, the
+                    lint runner (`run_lint`).
+  * `callgraph`   — package-wide call graph; discovers `jit`/`pjit`/
+                    `shard_map`/`scan` entry points and the set of
+                    functions reachable from a trace.
+  * `rules_jit`   — jit/tracer hygiene, recompilation hazards, donation
+                    safety (families JH/RC/DN).
+  * `rules_concurrency` — threaded-state and lock discipline (family CC).
+  * `sanitizer`   — the runtime side: tracer-leak/debug-nans config,
+                    thread-leak watchdog, order-asserting lock shims,
+                    exposed to tests via the `sanitize` pytest marker.
+
+CLI: `python -m tools.graftlint deeplearning4j_tpu/` (see
+`analysis.cli`). Suppression: `# graftlint: disable=<rule>[,<rule>...]`
+on the offending line, `# graftlint: disable-file=<rule>` anywhere in a
+file; accepted findings live in `graftlint_baseline.json`.
+"""
+from .engine import (Finding, LintResult, Project, RULES, load_baseline,
+                     run_lint, write_baseline)
+from .sanitizer import (LockOrderError, SanitizerReport, ThreadLeakError,
+                        sanitize)
+
+__all__ = ["Finding", "LintResult", "Project", "RULES", "run_lint",
+           "load_baseline", "write_baseline", "sanitize", "SanitizerReport",
+           "ThreadLeakError", "LockOrderError"]

@@ -1,0 +1,488 @@
+"""Package call graph + JAX trace-entry discovery.
+
+The jit/tracer-hygiene rules need to know which functions execute under a
+JAX trace. That set is discovered, not annotated: every `jax.jit` /
+`pjit` / `shard_map` / `lax.scan|while_loop|cond|fori_loop` / `vmap` /
+`grad` call site (and decorator) in the package marks its callee as a
+**trace root**, and everything reachable from a root through the
+package-local call graph is considered traced.
+
+Resolution is deliberately best-effort AST-level: plain names resolve
+lexically (nested defs, then module top level, then project-local
+imports), `self.m()` resolves within the enclosing class (then named
+base classes), `module.f()` through import aliases. Unresolvable calls
+(data-driven dispatch, third-party callables) are dropped — the rules
+prefer false negatives over noisy false positives.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["CallGraph", "FuncInfo", "JitSite"]
+
+# attribute-chain tails that make their callee argument(s) traced
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "shard_map",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "named_call",
+}
+# lax control flow: positions of traced callee args
+_TRACE_CONTROL = {
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,), "cond": (1, 2, 3),
+    "switch": None,   # every positional arg after the index may be a branch
+    "associative_scan": (0,), "map": (0,),
+}
+# jit-like constructors (the recompile/donation rules key off these
+# specifically, not off control-flow primitives)
+_JIT_MAKERS = {"jit", "pjit"}
+
+
+def walk_shallow(body) -> "list[ast.AST]":
+    """Walk statements/expressions WITHOUT descending into nested
+    function/lambda/class bodies — each def owns its own nodes (calls in
+    a closure belong to the closure's call-graph entry, not its parent's).
+    The nested def node itself IS yielded (so `jax.jit(inner)` sites and
+    decorators stay visible to the enclosing scope's rules)."""
+    out: List[ast.AST] = []
+    stack = list(body) if isinstance(body, (list, tuple)) else [body]
+    while stack:
+        node = stack.pop()
+        if not isinstance(node, ast.AST):
+            continue
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # decorators/defaults evaluate in the enclosing scope
+            stack.extend(getattr(node, "decorator_list", []))
+            if getattr(node, "args", None) is not None:
+                stack.extend(d for d in node.args.defaults if d is not None)
+                stack.extend(d for d in node.args.kw_defaults
+                             if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class FuncInfo:
+    qualname: str                  # "pkg.mod:Class.method" / "pkg.mod:fn.inner"
+    module: str
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef | Lambda
+    sf: object                     # SourceFile
+    class_name: Optional[str] = None
+    params: Tuple[str, ...] = ()
+    static_params: Set[str] = field(default_factory=set)
+    calls: Set[str] = field(default_factory=set)      # resolved callee qualnames
+    traced_root: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+
+
+@dataclass
+class JitSite:
+    """One jit/pjit construction site (`jax.jit(f, ...)`)."""
+    sf: object
+    node: ast.Call
+    scope: str                     # enclosing qualname
+    callee: Optional[str]          # resolved qualname of the jitted fn
+    donate: Tuple[int, ...] = ()
+    donate_names: Tuple[str, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    watched: bool = False          # wrapped in telemetry watch_compiles(...)
+    binding: Optional[str] = None  # name/attr the jitted callable is bound to
+
+
+def _params_of(node) -> Tuple[str, ...]:
+    a = node.args
+    names = [x.arg for x in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [x.arg for x in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+def _int_tuple(node) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """One pass per module: function defs with lexical scopes, class
+    layout, import aliases."""
+
+    def __init__(self, sf, graph: "CallGraph"):
+        self.sf = sf
+        self.graph = graph
+        self.stack: List[str] = []         # qualname components
+        self.class_stack: List[Optional[str]] = []
+        self.scope_defs: List[Dict[str, str]] = [{}]  # name -> qualname
+        self.module = sf.module
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            alias = a.asname or a.name.split(".")[0]
+            self.graph.imports.setdefault(self.module, {})[alias] = \
+                (a.name, None)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        src = node.module or ""
+        if node.level:
+            base = self.module.split(".")
+            # conftest-style: module 'a.b.c' with level 1 -> package 'a.b'
+            base = base[: len(base) - node.level]
+            src = ".".join(base + ([src] if src else []))
+        for a in node.names:
+            alias = a.asname or a.name
+            self.graph.imports.setdefault(self.module, {})[alias] = \
+                (src, a.name)
+
+    # -- defs -----------------------------------------------------------
+    def _qual(self, name: str) -> str:
+        return f"{self.module}:{'.'.join(self.stack + [name])}" \
+            if self.stack else f"{self.module}:{name}"
+
+    def _handle_def(self, node):
+        qual = self._qual(node.name)
+        info = FuncInfo(qual, self.module, node, self.sf,
+                        class_name=self.class_stack[-1]
+                        if self.class_stack else None,
+                        params=_params_of(node))
+        self.graph.funcs[qual] = info
+        self.scope_defs[-1][node.name] = qual
+        if info.class_name:
+            self.graph.methods.setdefault(
+                (self.module, info.class_name), {})[node.name] = qual
+            self.graph.method_names.setdefault(node.name, []).append(qual)
+        elif not self.stack:
+            self.graph.toplevel.setdefault(self.module, {})[node.name] = qual
+        self.stack.append(node.name)
+        self.class_stack.append(self.class_stack[-1]
+                                if self.class_stack else None)
+        self.scope_defs.append({})
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.scope_defs.pop()
+        self.class_stack.pop()
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._handle_def(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._handle_def(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        bases = [attr_chain(b) for b in node.bases]
+        self.graph.class_bases[(self.module, node.name)] = \
+            [b for b in bases if b]
+        self.stack.append(node.name)
+        self.class_stack.append(node.name)
+        self.scope_defs.append({})
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.scope_defs.pop()
+        self.class_stack.pop()
+        self.stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda):
+        qual = self._qual(f"<lambda@{node.lineno}>")
+        self.graph.funcs[qual] = FuncInfo(
+            qual, self.module, node, self.sf,
+            class_name=self.class_stack[-1] if self.class_stack else None,
+            params=_params_of(node))
+        self.graph.lambda_quals[id(node)] = qual
+        self.generic_visit(node)
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.project = project
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.toplevel: Dict[str, Dict[str, str]] = {}
+        self.methods: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.method_names: Dict[str, List[str]] = {}
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        self.imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        self.lambda_quals: Dict[int, str] = {}
+        self.jit_sites: List[JitSite] = []
+        self.watch_names: Set[str] = set()   # CompileWatcher-covered names
+        self.thread_targets: Set[str] = set()
+        for sf in project.files:
+            _ModuleIndexer(sf, self).visit(sf.tree)
+        # module-level statements form a pseudo-function per module so
+        # top-level jit sites / thread spawns are discovered too
+        for sf in project.files:
+            qual = f"{sf.module}:<module>"
+            self.funcs[qual] = FuncInfo(qual, sf.module, sf.tree, sf)
+        self._link()
+        self.traced: Set[str] = self._reach(
+            {q for q, f in self.funcs.items() if f.traced_root})
+        self.thread_reachable: Set[str] = self._reach(self.thread_targets)
+
+    # -- name resolution -------------------------------------------------
+    def resolve_name(self, module: str, scopes: List[ast.AST], name: str
+                     ) -> Optional[str]:
+        """Lexical lookup: enclosing defs' nested functions, module top
+        level, then project-local imports."""
+        for scope in reversed(scopes):
+            qual = self._scoped.get((id(scope), name))
+            if qual:
+                return qual
+        qual = self.toplevel.get(module, {}).get(name)
+        if qual:
+            return qual
+        imp = self.imports.get(module, {}).get(name)
+        if imp:
+            src, item = imp
+            if item is None:
+                return None                      # bare module import
+            tl = self.toplevel.get(src)
+            if tl and item in tl:
+                return tl[item]
+        return None
+
+    def resolve_method(self, module: str, class_name: Optional[str],
+                       name: str) -> Optional[str]:
+        """self.<name>() within class_name (searching named bases, then a
+        globally-unique method name as last resort)."""
+        seen = set()
+        stack = [(module, class_name)] if class_name else []
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            qual = self.methods.get(key, {}).get(name)
+            if qual:
+                return qual
+            for base in self.class_bases.get(key, []):
+                base_name = base.rsplit(".", 1)[-1]
+                for (m, c) in self.methods:
+                    if c == base_name:
+                        stack.append((m, c))
+        quals = self.method_names.get(name, [])
+        return quals[0] if len(quals) == 1 else None
+
+    def resolve_call_target(self, sf, scopes: List[ast.AST],
+                            class_name: Optional[str], func: ast.AST
+                            ) -> Optional[str]:
+        if isinstance(func, ast.Lambda):
+            return self.lambda_quals.get(id(func))
+        if isinstance(func, ast.Name):
+            return self.resolve_name(sf.module, scopes, func.id)
+        if isinstance(func, ast.Attribute):
+            chain = attr_chain(func)
+            if chain is None:
+                return None
+            head, _, rest = chain.partition(".")
+            if head in ("self", "cls") and rest and "." not in rest:
+                return self.resolve_method(sf.module, class_name, rest)
+            imp = self.imports.get(sf.module, {}).get(head)
+            if imp and rest and "." not in rest:
+                src, item = imp
+                mod = src if item is None else (
+                    f"{src}.{item}" if f"{src}.{item}" in self.toplevel
+                    else None)
+                if mod:
+                    return self.toplevel.get(mod, {}).get(rest)
+        return None
+
+    # -- linking pass -----------------------------------------------------
+    def _link(self):
+        # map (scope-node id, fname) -> qual for lexical lookup
+        self._scoped: Dict[Tuple[int, str], str] = {}
+        for qual, info in self.funcs.items():
+            mod_prefix, _, dotted = qual.partition(":")
+            parent = dotted.rsplit(".", 1)[0] if "." in dotted else None
+            if parent is not None:
+                pq = f"{mod_prefix}:{parent}"
+                pinfo = self.funcs.get(pq)
+                if pinfo is not None:
+                    self._scoped[(id(pinfo.node), info.name)] = qual
+        for qual, info in list(self.funcs.items()):
+            self._link_one(info)
+
+    def _enclosing_scopes(self, info: FuncInfo) -> List[ast.AST]:
+        scopes = []
+        mod_prefix, _, dotted = info.qualname.partition(":")
+        parts = dotted.split(".")
+        for i in range(1, len(parts) + 1):
+            q = f"{mod_prefix}:{'.'.join(parts[:i])}"
+            f = self.funcs.get(q)
+            if f is not None:
+                scopes.append(f.node)
+        return scopes
+
+    def _link_one(self, info: FuncInfo):
+        sf = info.sf
+        scopes = self._enclosing_scopes(info)
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        for node in walk_shallow(body):
+            if isinstance(node, ast.Call):
+                self._record_call(info, sf, scopes, node)
+        # decorators are trace roots too (@jax.jit / @partial(jax.jit,...))
+        for deco in getattr(info.node, "decorator_list", []):
+            jit = self._jit_like(deco if isinstance(deco, ast.Call) else deco)
+            if jit:
+                info.traced_root = True
+                tail = jit.rsplit(".", 1)[-1]
+                if tail in _JIT_MAKERS and isinstance(deco, ast.Call):
+                    self.jit_sites.append(self._mk_site(
+                        info.sf, deco, info.qualname, info.qualname,
+                        binding=info.name))
+
+    def _jit_like(self, node) -> Optional[str]:
+        """The trace-wrapper chain named by `node`, unwrapping
+        functools.partial(jax.jit, ...) forms."""
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain.rsplit(".", 1)[-1] == "partial" and node.args:
+                return self._jit_like(node.args[0])
+            return chain if chain and chain.rsplit(".", 1)[-1] in (
+                _TRACE_WRAPPERS | set(_TRACE_CONTROL)) else None
+        chain = attr_chain(node)
+        if chain is None:
+            return None
+        tail = chain.rsplit(".", 1)[-1]
+        return chain if tail in (_TRACE_WRAPPERS | set(_TRACE_CONTROL)) \
+            else None
+
+    def _mk_site(self, sf, call: ast.Call, scope: str,
+                 callee: Optional[str], binding=None) -> JitSite:
+        site = JitSite(sf, call, scope, callee, binding=binding)
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                site.donate = _int_tuple(kw.value)
+            elif kw.arg == "donate_argnames":
+                site.donate_names = _str_tuple(kw.value)
+            elif kw.arg == "static_argnums":
+                site.static_argnums = _int_tuple(kw.value)
+            elif kw.arg == "static_argnames":
+                site.static_argnames = _str_tuple(kw.value)
+        return site
+
+    def _record_call(self, info: FuncInfo, sf, scopes, node: ast.Call):
+        chain = attr_chain(node.func)
+        tail = chain.rsplit(".", 1)[-1] if chain else None
+        # telemetry coverage: watch_compiles(fn, "name")
+        if tail == "watch_compiles":
+            for arg in node.args[1:]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                                str):
+                    self.watch_names.add(arg.value)
+        # trace roots
+        if tail in _TRACE_WRAPPERS and (
+                chain == tail or chain.startswith(("jax.", "lax."))
+                or tail == "shard_map"):
+            positions: Sequence[int] = (0,)
+            self._mark_traced(info, sf, scopes, node, positions,
+                              jit=tail in _JIT_MAKERS)
+        elif tail in _TRACE_CONTROL:
+            # require a jax-ish prefix for control-flow names (plain
+            # `map`/`scan` calls on host objects must not count)
+            if "lax" in chain or chain.startswith("jax."):
+                positions = _TRACE_CONTROL[tail]
+                if positions is None:
+                    positions = tuple(range(len(node.args)))
+                self._mark_traced(info, sf, scopes, node, positions,
+                                  jit=False)
+        # thread targets
+        if tail == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    q = self.resolve_call_target(sf, scopes,
+                                                 info.class_name, kw.value)
+                    if q:
+                        self.thread_targets.add(q)
+        elif tail == "submit" and node.args:
+            q = self.resolve_call_target(sf, scopes, info.class_name,
+                                         node.args[0])
+            if q:
+                self.thread_targets.add(q)
+        # plain call edge
+        q = self.resolve_call_target(sf, scopes, info.class_name, node.func)
+        if q:
+            info.calls.add(q)
+
+    def _mark_traced(self, info: FuncInfo, sf, scopes, node: ast.Call,
+                     positions: Sequence[int], jit: bool):
+        site: Optional[JitSite] = None
+        for pos in positions:
+            if pos >= len(node.args):
+                continue
+            callee = self.resolve_call_target(sf, scopes, info.class_name,
+                                              node.args[pos])
+            if jit and site is None:
+                site = self._mk_site(sf, node, info.qualname, callee)
+                self.jit_sites.append(site)
+            if callee is None:
+                continue
+            cinfo = self.funcs.get(callee)
+            if cinfo is None:
+                continue
+            cinfo.traced_root = True
+            if jit and site is not None:
+                # un-taint declared static params on the DIRECT callee
+                statics = set()
+                for i in site.static_argnums:
+                    if i < len(cinfo.params):
+                        statics.add(cinfo.params[i])
+                statics.update(n for n in site.static_argnames
+                               if n in cinfo.params)
+                cinfo.static_params |= statics
+
+    # -- reachability -----------------------------------------------------
+    def _reach(self, roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [q for q in roots if q in self.funcs]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            for callee in self.funcs[q].calls:
+                if callee not in seen and callee in self.funcs:
+                    stack.append(callee)
+        return seen
+
+    def is_traced(self, qualname: str) -> bool:
+        return qualname in self.traced

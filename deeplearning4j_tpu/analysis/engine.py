@@ -1,0 +1,294 @@
+"""graftlint core: source model, pragma suppression, baseline, runner.
+
+Design constraints, in order:
+
+  * **Self-hosting must stay cheap.** The whole ~25k-line package parses
+    and lints in a couple of seconds (pure `ast`, one pass per file), so
+    the lint can run inside the tier-1 test suite as a hard CI gate.
+  * **Findings must be stable across unrelated edits.** A baseline keyed
+    on line numbers churns on every PR; findings are keyed on
+    `(rule, file, enclosing-scope, normalized source line)` instead, so
+    only touching the flagged line itself invalidates its baseline entry.
+  * **Suppression is always visible in the diff.** Inline
+    `# graftlint: disable=<rule>` pragmas mark reviewed false positives
+    where they live; the baseline file holds the pre-existing accepted
+    findings so NEW findings fail CI while old ones are burned down
+    incrementally (the classic ratchet workflow).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "SourceFile", "Project", "LintResult", "RULES",
+           "rule", "run_lint", "load_baseline", "write_baseline",
+           "baseline_diff"]
+
+_PRAGMA = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[\w\-*]+(?:\s*,\s*[\w\-*]+)*)")
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    family: str          # "jit-hygiene" | "recompile" | "donation" | "concurrency"
+    description: str
+
+
+RULES: Dict[str, RuleInfo] = {}
+_CHECKERS: List[Tuple[RuleInfo, Callable]] = []
+
+
+def rule(id: str, family: str, description: str):
+    """Register a checker: `fn(project) -> Iterable[Finding]`. A checker
+    may emit several rule ids (cross-rule passes register under the id
+    they primarily own); every emitted id must be registered."""
+    info = RuleInfo(id, family, description)
+
+    def deco(fn):
+        RULES[id] = info
+        _CHECKERS.append((info, fn))
+        return fn
+    return deco
+
+
+def register_rule_id(id: str, family: str, description: str):
+    """Register an id emitted by a shared checker (no new pass)."""
+    RULES[id] = RuleInfo(id, family, description)
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+@dataclass
+class Finding:
+    rule: str
+    path: str            # package-relative, '/'-separated
+    line: int            # 1-based
+    col: int
+    message: str
+    scope: str = ""      # enclosing qualname ("" = module level)
+    snippet: str = ""    # stripped source line (baseline key material)
+
+    def key(self) -> str:
+        """Line-number-free identity used by the baseline (stable across
+        unrelated edits elsewhere in the file)."""
+        return f"{self.rule}|{self.path}|{self.scope}|{self.snippet}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope or '<module>'}] {self.message}")
+
+
+# ---------------------------------------------------------------------------
+# Source model
+# ---------------------------------------------------------------------------
+class SourceFile:
+    """One parsed module: AST + per-line pragma suppression sets."""
+
+    def __init__(self, path: str, relpath: str, module: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.module = module          # dotted module name
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line (1-based) -> set of disabled rule ids ('*' = all)
+        self.disabled: Dict[int, set] = {}
+        self.file_disabled: set = set()
+        for i, ln in enumerate(self.lines, 1):
+            if "graftlint" not in ln:
+                continue
+            m = _PRAGMA.search(ln)
+            if not m:
+                continue
+            ids = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            if m.group("scope"):
+                self.file_disabled |= ids
+            else:
+                self.disabled.setdefault(i, set()).update(ids)
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if "*" in self.file_disabled or rule_id in self.file_disabled:
+            return True
+        ids = self.disabled.get(line)
+        return bool(ids) and ("*" in ids or rule_id in ids)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+class Project:
+    """All lintable files under one or more roots, plus the lazily-built
+    call graph (shared by every rule pass)."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.by_module: Dict[str, SourceFile] = {f.module: f for f in files}
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    def finding(self, sf: SourceFile, rule_id: str, node: ast.AST,
+                message: str, scope: str = "") -> Optional[Finding]:
+        """Build a Finding unless a pragma suppresses it. Checkers emit
+        via this helper so suppression stays in one place."""
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        if sf.suppressed(rule_id, line):
+            return None
+        return Finding(rule_id, sf.relpath, line, col, message,
+                       scope=scope, snippet=sf.line_text(line))
+
+
+def _module_name(root: str, path: str) -> str:
+    rel = os.path.relpath(path, os.path.dirname(os.path.abspath(root)) or ".")
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def load_project(paths: Sequence[str],
+                 exclude: Sequence[str] = ("__pycache__",)) -> Project:
+    """Parse every .py file under `paths` (files or directories)."""
+    files: List[SourceFile] = []
+    for root in paths:
+        root = os.path.normpath(root)
+        if os.path.isfile(root):
+            candidates = [(root, root)]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d not in exclude]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        candidates.append((root, os.path.join(dirpath, fn)))
+        for r, path in candidates:
+            relpath = os.path.relpath(path,
+                                      os.path.dirname(os.path.abspath(r)))
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            try:
+                files.append(SourceFile(path, relpath, _module_name(r, path),
+                                        text))
+            except SyntaxError as e:
+                raise SyntaxError(f"graftlint cannot parse {path}: {e}")
+    return Project(files)
+
+
+# ---------------------------------------------------------------------------
+# Baseline workflow
+# ---------------------------------------------------------------------------
+def load_baseline(path: str) -> Dict[str, int]:
+    """{finding key: accepted count}. Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    payload = {
+        "comment": "graftlint accepted-findings baseline. Keys are "
+                   "rule|file|scope|source-line (line-number free). "
+                   "Regenerate with: python -m tools.graftlint "
+                   "deeplearning4j_tpu/ --write-baseline",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8", newline="\n") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def baseline_diff(findings: Sequence[Finding], baseline: Dict[str, int]
+                  ) -> Tuple[List[Finding], List[str]]:
+    """(new findings not covered by the baseline, stale baseline keys).
+    A key covers at most its accepted count — the ratchet: fixing one of
+    two identical findings then re-introducing it elsewhere still fails."""
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        if seen[k] > baseline.get(k, 0):
+            new.append(f)
+    stale = [k for k, n in sorted(baseline.items())
+             if seen.get(k, 0) < n]
+    return new, stale
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    new: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    files: int = 0
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def new_by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.new:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint `paths`; compare against the baseline when given. `rules`
+    restricts to a subset of rule ids (default: all registered)."""
+    from . import rules_concurrency  # noqa: F401  (registration side effect)
+    from . import rules_jit  # noqa: F401
+
+    project = load_project(paths)
+    wanted = set(rules) if rules else None
+    findings: List[Finding] = []
+    ran = set()
+    for info, checker in _CHECKERS:
+        if checker in ran:          # one checker may own several ids
+            continue
+        ran.add(checker)
+        for f in checker(project):
+            if f is None:
+                continue
+            if wanted is not None and f.rule not in wanted:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result = LintResult(findings=findings, files=len(project.files))
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    if wanted is not None:
+        # a rule-restricted run must only be judged against (and must
+        # not report as stale) baseline entries for the selected rules
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split("|", 1)[0] in wanted}
+    result.new, result.stale_baseline = baseline_diff(findings, baseline)
+    return result

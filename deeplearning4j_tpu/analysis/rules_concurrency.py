@@ -1,0 +1,519 @@
+"""Rule family CC: concurrency lint for the threaded subsystems.
+
+Three passes over the same lock model:
+
+  * **Lock discovery** — `self.X = threading.Lock()/RLock()/Condition()`
+    declares lock identity `(module, Class, X)`; module-level
+    `X = threading.Lock()` declares `(module, None, X)`. Identities are
+    class-level (all instances of `ModelRegistry._lock` are one node in
+    the order graph), the standard coarsening for static deadlock
+    analysis.
+  * **blocking-call-under-lock** — inside a `with <lock>:` block, flag
+    calls that can block indefinitely or for unbounded time: sleeps,
+    thread joins, event waits, bare queue gets, network/subprocess I/O,
+    device syncs — directly or transitively through package-local calls
+    (`offset_ms -> _refresh -> socket.create_connection` is one hop).
+    Every other thread touching that lock stalls behind the slow holder.
+  * **lock-order-cycle** — acquisition-order edges are extracted from
+    `with` nesting plus one level of interprocedural propagation (a call
+    made while holding L contributes L -> every lock its callee may
+    acquire, transitively). A cycle in that graph is a potential
+    deadlock interleaving.
+
+`unlocked-global-mutation` flags in-place mutation of module-level
+mutable containers from thread-reachable code outside any lock;
+rebinding a module global (`_active = session`) is GIL-atomic and stays
+quiet, as do `threading.local()` instances.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import attr_chain, walk_shallow
+from .engine import Finding, Project, register_rule_id, rule
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTABLE_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "add", "update", "setdefault", "pop", "popleft", "popitem",
+             "remove", "discard", "clear", "__setitem__"}
+
+LockId = Tuple[str, Optional[str], str]     # (module, class or None, attr)
+
+
+# ---------------------------------------------------------------------------
+# Lock model
+# ---------------------------------------------------------------------------
+class LockModel:
+    def __init__(self, project: Project):
+        self.project = project
+        self.class_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}
+        self.attr_owners: Dict[str, List[LockId]] = {}
+        self._discover()
+
+    def _discover(self):
+        for sf in self.project.files:
+            for cls, node in _classes(sf.tree):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            _is_lock_ctor(sub.value):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                self._add((sf.module, cls, t.attr))
+            for stmt in sf.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        _is_lock_ctor(stmt.value):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self._add((sf.module, None, t.id))
+
+    def _add(self, lid: LockId):
+        module, cls, attr = lid
+        if cls is not None:
+            self.class_locks.setdefault((module, cls), set()).add(attr)
+        else:
+            self.module_locks.setdefault(module, set()).add(attr)
+        self.attr_owners.setdefault(attr, []).append(lid)
+
+    def resolve(self, expr: ast.AST, module: str,
+                class_name: Optional[str]) -> Optional[LockId]:
+        """Lock identity of a with-item / receiver expression."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks.get(module, set()):
+                return (module, None, expr.id)
+            owners = self.attr_owners.get(expr.id, [])
+            return owners[0] if len(owners) == 1 else None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and class_name:
+                if attr in self.class_locks.get((module, class_name),
+                                                set()):
+                    return (module, class_name, attr)
+            owners = self.attr_owners.get(attr, [])
+            if len(owners) == 1:
+                return owners[0]
+        return None
+
+
+def _classes(tree) -> List[Tuple[str, ast.ClassDef]]:
+    return [(n.name, n) for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef)]
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    chain = attr_chain(value.func)
+    return bool(chain) and chain.rsplit(".", 1)[-1] in _LOCK_CTORS
+
+
+# ---------------------------------------------------------------------------
+# Blocking-call classification
+# ---------------------------------------------------------------------------
+_NET_PREFIXES = ("urllib.", "requests.", "http.client", "socket.")
+_SUBPROC = {"subprocess.run", "subprocess.check_call",
+            "subprocess.check_output", "subprocess.call"}
+
+
+def _direct_block_reason(node: ast.Call) -> Optional[str]:
+    chain = attr_chain(node.func)
+    tail = node.func.attr if isinstance(node.func, ast.Attribute) else chain
+    if chain == "time.sleep":
+        return "time.sleep"
+    if chain and (chain.startswith(_NET_PREFIXES) or chain in _SUBPROC
+                  or chain == "socket.create_connection"):
+        return chain
+    if tail == "block_until_ready" or chain == "jax.block_until_ready" \
+            or chain == "jax.device_get":
+        return "device sync"
+    if tail == "join" and isinstance(node.func, ast.Attribute):
+        recv = attr_chain(node.func.value) or ""
+        # str.join / os.path.join have an iterable arg & path-ish chains
+        if any(k in recv.lower() for k in ("thread", "worker", "proc")) \
+                or not node.args:
+            return f"{recv or '<thread>'}.join"
+    if tail == "wait" and isinstance(node.func, ast.Attribute):
+        recv = (attr_chain(node.func.value) or "").lower()
+        # Condition.wait releases the held lock — that's its contract
+        if not any(k in recv for k in ("cond", "cv", "_not_")):
+            return f"{attr_chain(node.func.value) or '<event>'}.wait"
+    if tail == "get" and isinstance(node.func, ast.Attribute) \
+            and not node.args:
+        recv = (attr_chain(node.func.value) or "").lower()
+        if "queue" in recv or recv.endswith("_q"):
+            return f"{attr_chain(node.func.value)}.get"
+    return None
+
+
+def _direct_blocks(info) -> Dict[int, str]:
+    """{Call node id: reason} for direct blocking ops in one function."""
+    body = info.node.body if not isinstance(info.node, ast.Lambda) \
+        else [info.node.body]
+    out: Dict[int, str] = {}
+    for node in walk_shallow(body):
+        if isinstance(node, ast.Call):
+            reason = _direct_block_reason(node)
+            if reason:
+                out[id(node)] = reason
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CC rules
+# ---------------------------------------------------------------------------
+register_rule_id("lock-order-cycle", "concurrency",
+                 "inconsistent lock-acquisition order across the "
+                 "codebase can deadlock")
+register_rule_id("unlocked-global-mutation", "concurrency",
+                 "module-level mutable state mutated from thread-"
+                 "reachable code without a lock")
+
+
+@rule("blocking-call-under-lock", "concurrency",
+      "a blocking operation (sleep/join/wait/queue.get/network/device "
+      "sync) runs while a lock is held — every waiter stalls behind it")
+def check_concurrency(project: Project):
+    cg = project.callgraph
+    locks = LockModel(project)
+    out: List[Finding] = []
+
+    # per-function direct blocking ops and directly-acquired locks
+    direct_blocks: Dict[str, Dict[int, str]] = {}
+    acquires: Dict[str, Set[LockId]] = {}
+    for qual, info in cg.funcs.items():
+        direct_blocks[qual] = _direct_blocks(info)
+        acq: Set[LockId] = set()
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        for node in walk_shallow(body):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = locks.resolve(item.context_expr, info.module,
+                                        info.class_name)
+                    if lid:
+                        acq.add(lid)
+        acquires[qual] = acq
+
+    # transitive closures over the call graph
+    block_reason = _transitive(cg, {q: (next(iter(v.values())) if v else
+                                        None)
+                                    for q, v in direct_blocks.items()})
+    locks_reach = _transitive_sets(cg, acquires)
+
+    edges: Dict[Tuple[LockId, LockId], Tuple] = {}
+    for qual, info in sorted(cg.funcs.items()):
+        sf = info.sf
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        self_blocks = direct_blocks[qual]
+        _walk_held(project, cg, locks, info, body, [], self_blocks,
+                   block_reason, locks_reach, edges, out)
+
+    out.extend(_report_cycles(project, edges))
+    out.extend(_check_global_mutation(project, cg, locks))
+    return [f for f in out if f is not None]
+
+
+def _walk_held(project, cg, locks, info, body, held: List[LockId],
+               self_blocks, block_reason, locks_reach, edges, out):
+    """Recursive descent tracking the with-lock stack."""
+    sf = info.sf
+    for stmt in (body if isinstance(body, (list, tuple)) else [body]):
+        if not isinstance(stmt, ast.AST):
+            continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(stmt, ast.With):
+            new_held = list(held)
+            for item in stmt.items:
+                lid = locks.resolve(item.context_expr, info.module,
+                                    info.class_name)
+                if lid:
+                    for h in new_held:
+                        if h != lid:
+                            edges.setdefault(
+                                (h, lid), (sf, stmt, info.qualname))
+                    new_held.append(lid)
+                elif held:
+                    # a non-lock context manager acquired while holding a
+                    # lock: `with socket.create_connection(...)` blocks
+                    # exactly like the plain-call form
+                    _check_calls_under_lock(
+                        project, cg, locks, info, [item.context_expr],
+                        held, self_blocks, block_reason, locks_reach,
+                        edges, out)
+            _walk_held(project, cg, locks, info, stmt.body, new_held,
+                       self_blocks, block_reason, locks_reach, edges, out)
+            continue
+        # non-with statement: check calls at this nesting level only —
+        # compound-statement bodies are handled by the recursion below,
+        # so restrict the scan to this statement's own expressions
+        if held:
+            _check_calls_under_lock(
+                project, cg, locks, info, _stmt_exprs(stmt), held,
+                self_blocks, block_reason, locks_reach, edges, out)
+        # recurse into nested blocks with the same held stack
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                _walk_held(project, cg, locks, info, sub, held,
+                           self_blocks, block_reason, locks_reach, edges,
+                           out)
+        for h in getattr(stmt, "handlers", []) or []:
+            _walk_held(project, cg, locks, info, h.body, held,
+                       self_blocks, block_reason, locks_reach, edges, out)
+
+
+def _check_calls_under_lock(project, cg, locks, info, exprs, held,
+                            self_blocks, block_reason, locks_reach,
+                            edges, out):
+    """Flag blocking calls (direct or transitive) inside `exprs` while
+    the locks in `held` are held, and record acquisition-order edges for
+    locks reachable through the callee."""
+    sf = info.sf
+    for node in walk_shallow(exprs):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = self_blocks.get(id(node))
+        callee = cg.resolve_call_target(
+            sf, [info.node], info.class_name, node.func)
+        if reason is None and callee is not None:
+            reason = block_reason.get(callee)
+            if reason is not None:
+                reason = f"{callee.split(':')[-1]} -> {reason}"
+        if reason is not None:
+            out.append(project.finding(
+                sf, "blocking-call-under-lock", node,
+                f"blocking operation ({reason}) while holding "
+                f"{_lid_str(held[-1])} — move the slow work outside "
+                "the critical section", scope=info.qualname))
+        if callee is not None:
+            for lid in locks_reach.get(callee, ()):
+                for h in held:
+                    if h != lid:
+                        edges.setdefault((h, lid), (sf, node,
+                                                    info.qualname))
+
+
+def _stmt_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The expressions evaluated AT this statement's nesting level (the
+    bodies of compound statements are visited by the recursion)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _lid_str(lid: LockId) -> str:
+    module, cls, attr = lid
+    short = module.rsplit(".", 1)[-1]
+    return f"{short}.{cls}.{attr}" if cls else f"{short}.{attr}"
+
+
+def _transitive(cg, direct: Dict[str, Optional[str]]
+                ) -> Dict[str, Optional[str]]:
+    """First blocking reason reachable from each function (memoized)."""
+    memo: Dict[str, Optional[str]] = {}
+
+    def visit(q, stack):
+        if q in memo:
+            return memo[q]
+        if q in stack:
+            return None
+        memo[q] = direct.get(q)        # provisional (cycle cut)
+        if memo[q] is None:
+            stack.add(q)
+            for callee in cg.funcs[q].calls:
+                if callee in cg.funcs:
+                    r = visit(callee, stack)
+                    if r is not None:
+                        memo[q] = f"{callee.split(':')[-1]} -> {r}" \
+                            if " -> " not in r else r
+                        break
+            stack.discard(q)
+        return memo[q]
+
+    for q in cg.funcs:
+        visit(q, set())
+    return memo
+
+
+def _transitive_sets(cg, direct: Dict[str, Set[LockId]]
+                     ) -> Dict[str, Set[LockId]]:
+    memo: Dict[str, Set[LockId]] = {}
+
+    def visit(q, stack) -> Set[LockId]:
+        if q in memo:
+            return memo[q]
+        if q in stack:
+            return set()
+        stack.add(q)
+        acc = set(direct.get(q, ()))
+        for callee in cg.funcs[q].calls:
+            if callee in cg.funcs:
+                acc |= visit(callee, stack)
+        stack.discard(q)
+        memo[q] = acc
+        return acc
+
+    for q in cg.funcs:
+        visit(q, set())
+    return memo
+
+
+def _report_cycles(project, edges) -> List[Finding]:
+    graph: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    out: List[Finding] = []
+    reported: Set[frozenset] = set()
+    for start in sorted(graph):
+        cycle = _find_cycle(graph, start)
+        if not cycle:
+            continue
+        key = frozenset(cycle)
+        if key in reported:
+            continue
+        reported.add(key)
+        a, b = cycle[0], cycle[1 % len(cycle)]
+        sf, node, scope = edges.get((a, b)) or next(
+            v for k, v in edges.items() if k[0] in key and k[1] in key)
+        path = " -> ".join(_lid_str(l) for l in cycle + [cycle[0]])
+        out.append(project.finding(
+            sf, "lock-order-cycle", node,
+            f"lock acquisition order cycle: {path} — two threads taking "
+            "these locks in opposite orders deadlock", scope=scope))
+    return out
+
+
+def _find_cycle(graph, start) -> Optional[List]:
+    path: List = []
+    on_path: Set = set()
+    seen: Set = set()
+
+    def dfs(n) -> Optional[List]:
+        if n in on_path:
+            i = path.index(n)
+            return path[i:]
+        if n in seen:
+            return None
+        seen.add(n)
+        on_path.add(n)
+        path.append(n)
+        for m in sorted(graph.get(n, ())):
+            found = dfs(m)
+            if found:
+                return found
+        on_path.discard(n)
+        path.pop()
+        return None
+
+    return dfs(start)
+
+
+# ---------------------------------------------------------------------------
+# unlocked-global-mutation
+# ---------------------------------------------------------------------------
+def _check_global_mutation(project, cg, locks: LockModel) -> List[Finding]:
+    out: List[Finding] = []
+    # module -> set of module-level mutable container names
+    mutables: Dict[str, Set[str]] = {}
+    for sf in project.files:
+        names: Set[str] = set()
+        for stmt in sf.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            v = stmt.value
+            is_mut = isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp))
+            if isinstance(v, ast.Call):
+                chain = attr_chain(v.func) or ""
+                tail = chain.rsplit(".", 1)[-1]
+                is_mut = tail in _MUTABLE_CTORS
+            if is_mut:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        if names:
+            mutables[sf.module] = names
+    if not mutables:
+        return out
+
+    for qual in sorted(cg.thread_reachable):
+        info = cg.funcs[qual]
+        names = mutables.get(info.module)
+        if not names:
+            continue
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        # local rebinds shadow the module global
+        local = set(info.params)
+        for node in walk_shallow(body):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        anc_with: Set[int] = set()
+        _mark_under_lock(locks, info, body, [], anc_with)
+        for node in walk_shallow(body):
+            target: Optional[str] = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    isinstance(node.func.value, ast.Name):
+                target = node.func.value.id
+            elif isinstance(node, (ast.Subscript,)) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    isinstance(node.value, ast.Name):
+                target = node.value.id
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                target = node.target.id
+            if target is None or target not in names or target in local:
+                continue
+            if id(node) in anc_with:
+                continue
+            out.append(project.finding(
+                info.sf, "unlocked-global-mutation", node,
+                f"module-level mutable '{target}' is mutated from "
+                "thread-reachable code without a lock — wrap the "
+                "mutation in a lock or make the state thread-local",
+                scope=qual))
+    return out
+
+
+def _mark_under_lock(locks, info, body, held, marked: Set[int]):
+    """Collect ids of every node lexically inside a with-lock block."""
+    for stmt in (body if isinstance(body, (list, tuple)) else [body]):
+        if not isinstance(stmt, ast.AST) or isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                       ast.Lambda)):
+            continue
+        if isinstance(stmt, ast.With):
+            locked = held or any(
+                locks.resolve(i.context_expr, info.module, info.class_name)
+                for i in stmt.items)
+            if locked:
+                for sub in walk_shallow(stmt.body):
+                    marked.add(id(sub))
+            _mark_under_lock(locks, info, stmt.body,
+                             held or locked, marked)
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                _mark_under_lock(locks, info, sub, held, marked)
+        for h in getattr(stmt, "handlers", []) or []:
+            _mark_under_lock(locks, info, h.body, held, marked)

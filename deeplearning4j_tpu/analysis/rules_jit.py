@@ -1,0 +1,668 @@
+"""Rule families JH (jit/tracer hygiene), RC (recompilation hazards),
+DN (donation safety).
+
+Everything here keys off the call graph's traced set: the functions that
+execute under a JAX trace (jit/pjit/shard_map/lax control flow callees
+and everything they reach). Host-side code is free to call `float()` or
+`np.asarray`; traced code is not — there it either crashes
+(ConcretizationTypeError), silently constant-folds trace-time state
+(wall clocks, Python RNG), or forces a device sync per step.
+
+Taint model: inside a traced function every parameter is a potential
+tracer EXCEPT parameters declared static at the jit site
+(`static_argnums`/`static_argnames` are propagated onto the direct
+callee). Attribute reads of `.shape`/`.ndim`/`.dtype` and `is None` /
+`isinstance` tests are shields — those are static under trace and
+branching on them is fine (rank/None specialization), while branching
+on the VALUES is not.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import attr_chain, walk_shallow
+from .engine import Finding, Project, register_rule_id, rule
+
+_SHIELD_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type", "itemsize", "nbytes"}
+_WALLCLOCK = {"time.time", "time.perf_counter", "time.monotonic",
+              "time.time_ns", "time.perf_counter_ns", "time.process_time"}
+_PY_RNG_PREFIX = ("random.", "np.random.", "numpy.random.")
+_NP_PREFIX = ("np.", "numpy.", "onp.")
+_HOT_HOOKS = {"iteration_done"}
+
+
+# ---------------------------------------------------------------------------
+# Taint helpers
+# ---------------------------------------------------------------------------
+# calls whose results are (pytrees of) traced arrays inside traced code
+_ARRAY_CALL_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.",
+                        "jax.nn.", "jax.random.", "jax.scipy.",
+                        "jax.vjp", "jax.jvp", "jax.grad",
+                        "jax.value_and_grad")
+
+
+def _collect_taint(info, cg=None) -> Set[str]:
+    """Names bound to ARRAY-DERIVED values: locals assigned from
+    jnp./jax.lax./jax.random. calls (or from calls into other traced
+    package functions), propagated through assignments in document
+    order.
+
+    Parameters are deliberately NOT seeded: in this codebase traced
+    functions routinely thread static config (train flags, activation
+    names, enum modes, partial-bound scalars) through their signatures,
+    and branching on those at trace time is idiomatic JAX — seeding
+    params flagged ~30 such branches and zero real ones. Branching on a
+    traced param also fails loudly on the very first trace, while
+    branching on a derived value can hide in a rarely-taken path; the
+    derived set is where a linter earns its keep."""
+    taint: Set[str] = set()
+    body = info.node.body if not isinstance(info.node, ast.Lambda) \
+        else [info.node.body]
+
+    def arrayish(expr) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                chain = attr_chain(n.func)
+                if chain and (chain.startswith(_ARRAY_CALL_PREFIXES)
+                              or chain in ("jnp", "lax")):
+                    return True
+                if cg is not None:
+                    q = cg.resolve_call_target(info.sf, [info.node],
+                                               info.class_name, n.func)
+                    if q is not None and q in cg.traced:
+                        return True
+        return _expr_tainted(expr, taint)
+
+    # document order matters (walk_shallow yields a stack order): sort
+    # binding sites by position, then run TWO forward passes so values
+    # flowing backward through a loop body still land
+    sites = sorted(
+        (n for n in walk_shallow(body)
+         if isinstance(n, (ast.Assign, ast.AugAssign, ast.For))),
+        key=lambda n: (n.lineno, n.col_offset))
+    for _ in range(2):
+        before = len(taint)
+        for node in sites:
+            if isinstance(node, ast.Assign):
+                if arrayish(node.value):
+                    for t in node.targets:
+                        taint.update(_target_names(t))
+            elif isinstance(node, ast.AugAssign):
+                if arrayish(node.value) and isinstance(node.target,
+                                                       ast.Name):
+                    taint.add(node.target.id)
+            elif isinstance(node, ast.For):
+                if _expr_tainted(node.iter, taint):
+                    taint.update(_target_names(node.target))
+        if len(taint) == before:
+            break
+    return taint
+
+
+def _target_names(t: ast.AST):
+    """Names BOUND by an assignment target. A subscript store taints the
+    container, never the index expression (`values[name] = ...` must not
+    taint `name`); attribute stores bind no local name."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+    elif isinstance(t, ast.Subscript):
+        yield from _target_names(t.value)
+
+
+def _expr_tainted(expr: Optional[ast.AST], taint: Set[str]) -> bool:
+    """Does `expr` read a tainted name OUTSIDE a shield context?"""
+    if expr is None or not taint:
+        return False
+    return _scan_taint(expr, taint)
+
+
+def _scan_taint(node: ast.AST, taint: Set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr in _SHIELD_ATTRS:
+        return False                      # x.shape / x.ndim / x.dtype
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain in ("len", "isinstance", "getattr", "hasattr", "type"):
+            return False                  # len(x) is shape-derived/static
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` — None-ness is static under trace
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    return any(_scan_taint(c, taint) for c in ast.iter_child_nodes(node))
+
+
+def _receiver_chain(node: ast.AST) -> Optional[str]:
+    return attr_chain(node)
+
+
+# ---------------------------------------------------------------------------
+# Parent-tracked walker (rules need ancestor context: loops, guards)
+# ---------------------------------------------------------------------------
+class _Ancestry:
+    """node id -> parent map, per function body (shallow)."""
+
+    def __init__(self, body):
+        self.parent: Dict[int, ast.AST] = {}
+        stack = list(body) if isinstance(body, (list, tuple)) else [body]
+        while stack:
+            node = stack.pop()
+            if not isinstance(node, ast.AST):
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            for child in ast.iter_child_nodes(node):
+                self.parent[id(child)] = node
+                stack.append(child)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(id(cur))
+
+    def in_loop(self, node: ast.AST) -> bool:
+        return any(isinstance(a, (ast.For, ast.While))
+                   for a in self.ancestors(node))
+
+    def under_if(self, node: ast.AST) -> bool:
+        return any(isinstance(a, (ast.If, ast.IfExp))
+                   for a in self.ancestors(node))
+
+
+# ---------------------------------------------------------------------------
+# JH: jit/tracer hygiene
+# ---------------------------------------------------------------------------
+register_rule_id("print-in-trace", "jit-hygiene",
+                 "print() inside trace-reachable code runs at trace time "
+                 "only (or forces a host sync via io callbacks)")
+register_rule_id("wallclock-in-trace", "jit-hygiene",
+                 "wall-clock read inside trace-reachable code is "
+                 "constant-folded at trace time")
+register_rule_id("python-rng-in-trace", "jit-hygiene",
+                 "Python/numpy RNG inside trace-reachable code freezes "
+                 "one sample into the compiled program")
+register_rule_id("traced-value-branch", "jit-hygiene",
+                 "Python branch on a traced value raises "
+                 "TracerBoolConversionError (or silently specializes)")
+
+
+@rule("host-sync-in-trace", "jit-hygiene",
+      "float()/int()/.item()/np.asarray on a traced value forces a "
+      "device->host sync (or ConcretizationTypeError) inside jitted code")
+def check_trace_hygiene(project: Project):
+    cg = project.callgraph
+    out: List[Finding] = []
+    for qual in sorted(cg.traced):
+        info = cg.funcs[qual]
+        sf = info.sf
+        taint = _collect_taint(info, cg)
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        for node in walk_shallow(body):
+            if isinstance(node, ast.Call):
+                out.extend(_check_traced_call(project, sf, qual, node, taint))
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if _expr_tainted(node.test, taint):
+                    out.append(project.finding(
+                        sf, "traced-value-branch", node,
+                        "branch condition reads a traced value — under "
+                        "jit this raises TracerBoolConversionError; hoist "
+                        "the branch or use lax.cond/jnp.where", scope=qual))
+            elif isinstance(node, ast.Assert):
+                if _expr_tainted(node.test, taint):
+                    out.append(project.finding(
+                        sf, "traced-value-branch", node,
+                        "assert on a traced value — use "
+                        "checkify/debug_nans instead", scope=qual))
+    return [f for f in out if f is not None]
+
+
+def _check_traced_call(project, sf, qual, node: ast.Call, taint
+                       ) -> List[Finding]:
+    out: List[Finding] = []
+    chain = attr_chain(node.func)
+
+    def emit(rule_id, msg):
+        f = project.finding(sf, rule_id, node, msg, scope=qual)
+        if f is not None:
+            out.append(f)
+
+    if chain == "print":
+        emit("print-in-trace",
+             "print() under trace runs once at trace time — use "
+             "jax.debug.print for per-step output")
+    elif chain in _WALLCLOCK:
+        emit("wallclock-in-trace",
+             f"{chain}() under trace is evaluated once at trace time and "
+             "baked into the compiled program")
+    elif chain and chain.startswith(_PY_RNG_PREFIX):
+        emit("python-rng-in-trace",
+             f"{chain}() under trace freezes one host RNG draw into the "
+             "compiled program — thread a jax.random key instead")
+    elif chain in ("float", "int", "bool", "complex"):
+        if node.args and _expr_tainted(node.args[0], taint):
+            emit("host-sync-in-trace",
+                 f"{chain}() on a traced value — raises "
+                 "ConcretizationTypeError under jit; keep it an array")
+    elif isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("item", "tolist"):
+        if _expr_tainted(node.func.value, taint):
+            emit("host-sync-in-trace",
+                 f".{node.func.attr}() on a traced value — host "
+                 "materialization inside jitted code")
+    elif chain and chain.startswith(_NP_PREFIX) and \
+            not chain.startswith(_PY_RNG_PREFIX):
+        if any(_expr_tainted(a, taint) for a in node.args):
+            emit("host-sync-in-trace",
+                 f"{chain}() on a traced value inside jitted code — "
+                 "numpy materializes on host; use jnp")
+    elif chain in ("jax.device_get",) or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"):
+        emit("host-sync-in-trace",
+             "explicit device sync inside trace-reachable code")
+    return out
+
+
+@rule("hot-loop-sync", "jit-hygiene",
+      "unconditional host materialization (float(score)/.item()/"
+      "np.asarray) in a per-iteration listener hook serializes the "
+      "async dispatch pipeline every training step")
+def check_hot_loop_sync(project: Project):
+    """Codebase-tuned: `iteration_done(model, iteration)` runs after
+    EVERY training step. The step's score is an unmaterialized device
+    value precisely so dispatch stays async; a listener that converts it
+    per call re-introduces a per-step device->host sync. Guarded reads
+    (inside any `if`, or after an early-return frequency gate) are the
+    sanctioned pattern and stay quiet."""
+    out: List[Finding] = []
+    cg = project.callgraph
+    for qual, info in sorted(cg.funcs.items()):
+        if info.name not in _HOT_HOOKS or isinstance(info.node, ast.Lambda):
+            continue
+        anc = _Ancestry(info.node.body)
+        has_gate = any(
+            isinstance(stmt, ast.If)
+            and any(isinstance(s, (ast.Return, ast.Continue))
+                    for s in stmt.body)
+            for stmt in info.node.body)
+        if has_gate:
+            continue
+        for node in walk_shallow(info.node.body):
+            if not isinstance(node, ast.Call) or anc.under_if(node):
+                continue
+            chain = attr_chain(node.func)
+            sync = None
+            if chain in ("float", "int") and node.args and any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    for n in ast.walk(node.args[0])):
+                # float(model.score()) materializes a device value;
+                # float(getattr(model, ...)) and friends do not
+                sync = f"{chain}(...)"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("item", "tolist",
+                                       "block_until_ready"):
+                sync = f".{node.func.attr}()"
+            elif chain and chain.startswith(_NP_PREFIX) and \
+                    chain.rsplit(".", 1)[-1] in ("asarray", "array"):
+                sync = chain
+            if sync:
+                f = project.finding(
+                    info.sf, "hot-loop-sync", node,
+                    f"{sync} runs unguarded on every iteration_done — "
+                    "gate it on a reporting interval (iteration % N) so "
+                    "the hot loop stays sync-free", scope=qual)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RC: recompilation hazards
+# ---------------------------------------------------------------------------
+register_rule_id("unhashable-static-arg", "recompile",
+                 "unhashable literal passed in a static jit position "
+                 "raises at call time")
+register_rule_id("shape-branch-in-trace", "recompile",
+                 "shape compared against a runtime variable in traced "
+                 "code specializes the compile per shape")
+register_rule_id("unwatched-jit-entry", "recompile",
+                 "jit entry point not covered by telemetry "
+                 "watch_compiles — recompilation storms here are "
+                 "invisible to CompileWatcher")
+
+
+@rule("jit-in-loop", "recompile",
+      "jax.jit constructed inside a loop builds a fresh cache per "
+      "iteration — every call recompiles")
+def check_recompile(project: Project):
+    out: List[Finding] = []
+    cg = project.callgraph
+    # RC001: jit construction inside loops + RC-unwatched cross-check
+    watched_calls = _watch_wrapped_calls(project)
+    for site in cg.jit_sites:
+        info = cg.funcs.get(site.scope)
+        if info is None:
+            continue
+        anc = _Ancestry(info.node.body
+                        if not isinstance(info.node, ast.Lambda)
+                        else [info.node.body])
+        if anc.in_loop(site.node):
+            f = project.finding(
+                site.sf, "jit-in-loop", site.node,
+                "jit constructed inside a loop: each iteration builds a "
+                "fresh jitted callable with an empty cache — hoist the "
+                "jit out of the loop", scope=site.scope)
+            if f is not None:
+                out.append(f)
+        if id(site.node) not in watched_calls:
+            f = project.finding(
+                site.sf, "unwatched-jit-entry", site.node,
+                "jit entry point is not wrapped in telemetry "
+                "watch_compiles(...) — CompileWatcher cannot attribute "
+                "recompilation storms to it", scope=site.scope)
+            if f is not None:
+                out.append(f)
+    # RC002: unhashable literals at static positions of known jit bindings
+    out.extend(_check_static_args(project))
+    # RC003: shape-vs-variable comparisons in traced code
+    for qual in sorted(cg.traced):
+        info = cg.funcs[qual]
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        for node in walk_shallow(body):
+            if isinstance(node, (ast.If, ast.While)) and \
+                    _shape_vs_variable(node.test):
+                f = project.finding(
+                    info.sf, "shape-branch-in-trace", node,
+                    "shape compared against a runtime variable inside "
+                    "traced code — every distinct value compiles its own "
+                    "program (unbounded specialization)", scope=qual)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def _watch_wrapped_calls(project) -> Set[int]:
+    """ids of jit Call nodes that appear as an argument (at any depth
+    inside the argument expression) of a watch_compiles(...) call, or in
+    a module that wires compiles into the watcher another way
+    (serving/registry records AOT compiles via record_aot)."""
+    wrapped: Set[int] = set()
+    for sf in project.files:
+        # a module only counts as AOT-covered if it actually CALLS
+        # record_aot (a comment/docstring mention must not bypass the
+        # gate)
+        records_aot = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "record_aot"
+            for n in ast.walk(sf.tree))
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1] if chain else None
+            if tail == "watch_compiles" and node.args:
+                for sub in ast.walk(node.args[0]):
+                    if isinstance(sub, ast.Call):
+                        wrapped.add(id(sub))
+            elif records_aot:
+                # module-local AOT accounting covers its own jit sites
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        sub_chain = attr_chain(sub.func)
+                        if sub_chain and sub_chain.rsplit(".", 1)[-1] in (
+                                "jit", "pjit"):
+                            wrapped.add(id(sub))
+    return wrapped
+
+
+def _shape_vs_variable(test: ast.AST) -> bool:
+    """`x.shape[0] < n` / `len(x) != budget` — shape against a
+    non-constant. Shape-vs-literal (`x.ndim == 3`) is bounded rank/shape
+    specialization and stays quiet."""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        shapeish = [s for s in sides if _is_shape_expr(s)]
+        if not shapeish:
+            continue
+        others = [s for s in sides if not _is_shape_expr(s)]
+        if others and not all(_is_const_like(o) for o in others):
+            return True
+    return False
+
+
+def _is_shape_expr(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+            return True
+        if isinstance(n, ast.Call) and attr_chain(n.func) == "len":
+            return True
+    return False
+
+
+def _is_const_like(node: ast.AST) -> bool:
+    return all(isinstance(n, (ast.Constant, ast.UnaryOp, ast.Tuple,
+                              ast.List, ast.expr_context, ast.unaryop))
+               for n in ast.walk(node))
+
+
+def _check_static_args(project) -> List[Finding]:
+    out: List[Finding] = []
+    bindings = _jit_bindings(project)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_binding_name(node.func)
+            if name is None or name not in bindings:
+                continue
+            site = bindings[name]
+            for i in site.static_argnums:
+                if i < len(node.args) and isinstance(
+                        node.args[i], (ast.List, ast.Dict, ast.Set)):
+                    f = project.finding(
+                        sf, "unhashable-static-arg", node.args[i],
+                        f"static arg {i} of '{name}' receives an "
+                        "unhashable literal — jit static args must be "
+                        "hashable (pass a tuple)", scope="")
+                    if f is not None:
+                        out.append(f)
+            for kw in node.keywords:
+                if kw.arg in site.static_argnames and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    f = project.finding(
+                        sf, "unhashable-static-arg", kw.value,
+                        f"static arg '{kw.arg}' of '{name}' receives an "
+                        "unhashable literal — pass a hashable value",
+                        scope="")
+                    if f is not None:
+                        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DN: donation safety
+# ---------------------------------------------------------------------------
+def _jit_bindings(project) -> Dict[str, "object"]:
+    """binding name -> JitSite for jit results bound to a name: plain
+    assignment (`f = jax.jit(...)`), attribute assignment
+    (`self._step = jax.jit(...)`), or returned from a method/
+    cached_property (binding = the method name)."""
+    cg = project.callgraph
+    by_call: Dict[int, object] = {id(s.node): s for s in cg.jit_sites}
+    bindings: Dict[str, object] = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            target: Optional[str] = None
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    target = t.id
+                elif isinstance(t, ast.Attribute):
+                    target = t.attr
+                value = node.value
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                rets = [n for n in walk_shallow(node.body)
+                        if isinstance(n, ast.Return) and n.value is not None]
+                if len(rets) == 1:
+                    target, value = node.name, rets[0].value
+            if target is None or value is None:
+                continue
+            for sub in ast.walk(value):
+                site = by_call.get(id(sub))
+                if site is not None:
+                    site.binding = target
+                    bindings[target] = site
+    return bindings
+
+
+@rule("donated-buffer-reuse", "donation",
+      "a binding passed in a donate_argnums position is read after the "
+      "call — its buffer may already be aliased/invalidated")
+def check_donation(project: Project):
+    out: List[Finding] = []
+    bindings = {n: s for n, s in _jit_bindings(project).items()
+                if s.donate or s.donate_names}
+    if not bindings:
+        return out
+    cg = project.callgraph
+    for qual, info in sorted(cg.funcs.items()):
+        if isinstance(info.node, ast.Lambda):
+            continue
+        body = info.node.body
+        stmts = _linear_stmts(body)
+        anc = _Ancestry(body)
+        for si, stmt in enumerate(stmts):
+            # only this statement's OWN expressions: a call nested in a
+            # compound statement's body belongs to the inner statement
+            # (whose assignment targets decide the rebinding check)
+            own = [c for c in ast.iter_child_nodes(stmt)
+                   if isinstance(c, ast.expr)]
+            for call in walk_shallow(own):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = _call_binding_name(call.func)
+                if name is None or name not in bindings:
+                    continue
+                site = bindings[name]
+                donated = _donated_arg_exprs(call, site)
+                if not donated:
+                    continue
+                rebound = _stmt_targets(stmt)
+                for dchain in donated:
+                    if dchain in rebound:
+                        continue          # x, ... = f(x, ...) — safe
+                    if anc.in_loop(call):
+                        # loop carry: the same un-rebound binding is
+                        # passed (and thus read) again next iteration
+                        f = project.finding(
+                            info.sf, "donated-buffer-reuse", call,
+                            f"'{dchain}' is donated to '{name}' inside "
+                            "a loop without being rebound from the "
+                            "result — the next iteration reads a "
+                            "donated buffer", scope=qual)
+                        if f is not None:
+                            out.append(f)
+                        continue
+                    misuse = _read_after(stmts, si, dchain, call)
+                    if misuse is not None:
+                        f = project.finding(
+                            info.sf, "donated-buffer-reuse", misuse,
+                            f"'{dchain}' was donated to '{name}' above "
+                            "(donate_argnums) and is read again — the "
+                            "buffer may have been invalidated; rebind "
+                            "the result or drop the donation",
+                            scope=qual)
+                        if f is not None:
+                            out.append(f)
+    return out
+
+
+def _call_binding_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _donated_arg_exprs(call: ast.Call, site) -> List[str]:
+    out = []
+    for i in site.donate:
+        if i < len(call.args):
+            chain = attr_chain(call.args[i])
+            if chain:
+                out.append(chain)
+    for kw in call.keywords:
+        if kw.arg in site.donate_names:
+            chain = attr_chain(kw.value)
+            if chain:
+                out.append(chain)
+    return out
+
+
+def _stmt_targets(stmt: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    targets: Sequence[ast.AST] = ()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = (stmt.target,)
+    for t in targets:
+        for n in ast.walk(t):
+            chain = attr_chain(n)
+            if chain:
+                out.add(chain)
+    return out
+
+
+def _linear_stmts(body) -> List[ast.AST]:
+    """Statements in document order, flattened through compound
+    statements but not into nested defs."""
+    out: List[ast.AST] = []
+
+    def rec(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            out.append(s)
+            for field in ("body", "orelse", "finalbody"):
+                rec(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                rec(h.body)
+    rec(body)
+    return out
+
+
+def _read_after(stmts: List[ast.AST], call_idx: int, chain: str,
+                call: ast.Call) -> Optional[ast.AST]:
+    """First Load of `chain` after the donating call before any
+    rebinding; linear over the flattened statement list."""
+    for stmt in stmts[call_idx + 1:]:
+        if chain in _stmt_targets(stmt):
+            # value side may still read it first (x = g(x)): a read of a
+            # donated buffer even here — but rebinding from the donated
+            # value is the dominant safe idiom; treat as rebind
+            return None
+        for n in walk_shallow([stmt]):
+            if isinstance(n, (ast.Name, ast.Attribute)) and \
+                    attr_chain(n) == chain and \
+                    isinstance(getattr(n, "ctx", None), ast.Load):
+                return stmt
+    return None

@@ -1,0 +1,284 @@
+"""Runtime sanitizer: the dynamic half of graftlint.
+
+Static rules catch what the AST shows; these shims catch what only shows
+at runtime — leaked tracers, NaN-producing steps, threads that outlive
+their owner, and lock acquisitions that contradict the declared order.
+
+    with sanitize(thread_watchdog=True, lock_order=True) as report:
+        ... run the concurrency-heavy code ...
+    # exiting raises ThreadLeakError / LockOrderError on violations
+
+Pieces (each individually optional):
+
+  * `tracer_leaks=True`  — flips `jax_check_tracer_leaks` for the block.
+  * `debug_nans=True`    — flips `jax_debug_nans` for the block (leave
+    off for suites that INJECT NaNs deliberately, e.g. fault/).
+  * `thread_watchdog`    — snapshots live threads on entry; on exit,
+    threads started inside the block get `grace_s` to finish, then any
+    survivor (name not matching `allow_threads`) raises ThreadLeakError.
+    This is the check that keeps "every subsystem joins its workers"
+    true as the threaded surface grows.
+  * `lock_order`         — wraps the lock attributes of serving's
+    known lock-bearing classes (ModelRegistry, InferenceServer entries)
+    in order-asserting shims for instances constructed INSIDE the block:
+    each thread's held-lock stack is tracked and the global pairwise
+    acquisition order must stay consistent; a contradiction is recorded
+    and raised at block exit (raising inside a worker thread would just
+    kill the worker silently).
+
+Pytest integration (tests/conftest.py): mark a module or test with
+`@pytest.mark.sanitize` (kwargs forwarded) and the autouse fixture wraps
+the test body in this context manager.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["sanitize", "SanitizerReport", "ThreadLeakError",
+           "LockOrderError", "OrderCheckedLock", "LockOrderWatch",
+           "wrap_lock_attrs"]
+
+
+class ThreadLeakError(AssertionError):
+    """Threads started inside a sanitized block outlived it."""
+
+
+class LockOrderError(AssertionError):
+    """Two lock acquisitions contradict the established global order."""
+
+
+@dataclass
+class SanitizerReport:
+    leaked_threads: List[str] = field(default_factory=list)
+    lock_violations: List[str] = field(default_factory=list)
+    checked_locks: int = 0
+    started_threads: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Order-asserting lock shim
+# ---------------------------------------------------------------------------
+class LockOrderWatch:
+    """Shared order registry for a family of OrderCheckedLocks: records
+    (held -> acquired) pairs and flags the first contradiction. Lock
+    identity is the NAME given at wrap time (class-level, matching the
+    static analyzer's granularity)."""
+
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._order: Dict[Tuple[str, str], str] = {}   # (a, b) -> where
+        self._held = threading.local()
+        self.violations: List[str] = []
+        self.wrapped = 0
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def on_acquire(self, name: str):
+        stack = self._stack()
+        if name in stack:          # reentrant (RLock) — no new edge
+            stack.append(name)
+            return
+        where = threading.current_thread().name
+        with self._meta:
+            for held in stack:
+                if held == name:
+                    continue
+                if (name, held) in self._order:
+                    self.violations.append(
+                        f"lock order violation: acquiring '{name}' while "
+                        f"holding '{held}' (thread {where}), but the "
+                        f"opposite order was established at "
+                        f"{self._order[(name, held)]}")
+                self._order.setdefault((held, name), where)
+        stack.append(name)
+
+    def on_release(self, name: str):
+        stack = self._stack()
+        if name in stack:
+            # remove the most recent acquisition of this name
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == name:
+                    del stack[i]
+                    break
+
+
+class OrderCheckedLock:
+    """Duck-typed Lock/RLock proxy feeding a LockOrderWatch. Supports
+    the subset of the lock API the codebase uses (context manager,
+    acquire/release, locked)."""
+
+    def __init__(self, inner, name: str, watch: LockOrderWatch):
+        self._inner = inner
+        self._name = name
+        self._watch = watch
+        watch.wrapped += 1
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._watch.on_acquire(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._watch.on_release(self._name)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def wrap_lock_attrs(obj, watch: LockOrderWatch,
+                    attrs: Optional[Sequence[str]] = None,
+                    prefix: Optional[str] = None) -> int:
+    """Replace `obj`'s lock-valued attributes with order-checked proxies
+    (auto-discovered when `attrs` is None). Returns the wrap count."""
+    lock_types = tuple({type(threading.Lock()), type(threading.RLock())})
+    if attrs is not None:
+        names = attrs
+    else:
+        try:
+            candidates = list(vars(obj))
+        except TypeError:       # __slots__ class (serving._Entry)
+            candidates = [s for klass in type(obj).__mro__
+                          for s in getattr(klass, "__slots__", ())]
+        names = [k for k in candidates
+                 if isinstance(getattr(obj, k, None), lock_types)]
+    prefix = prefix or type(obj).__name__
+    n = 0
+    for k in names:
+        v = getattr(obj, k, None)
+        if v is None or isinstance(v, OrderCheckedLock):
+            continue
+        setattr(obj, k, OrderCheckedLock(v, f"{prefix}.{k}", watch))
+        n += 1
+    return n
+
+
+# classes whose instances get their locks auto-wrapped when constructed
+# inside a sanitize(lock_order=True) block: the serving plane's
+# lock-bearing objects (the lint's lock-order pass covers the same set)
+def _lock_order_patch_points():
+    from ..serving.batcher import DynamicBatcher
+    from ..serving.registry import ModelRegistry, _Entry
+    from ..serving.server import InferenceServer
+    return [(ModelRegistry, None), (_Entry, None),
+            (InferenceServer, None), (DynamicBatcher, None)]
+
+
+@contextlib.contextmanager
+def _patched_lock_order(watch: LockOrderWatch):
+    patched = []
+    try:
+        points = _lock_order_patch_points()
+    except Exception:       # serving unavailable (minimal env) — no-op
+        points = []
+    for cls, attrs in points:
+        orig = cls.__init__
+
+        def make(orig, cls, attrs):
+            def __init__(self, *a, **kw):
+                orig(self, *a, **kw)
+                wrap_lock_attrs(self, watch, attrs)
+            return __init__
+
+        cls.__init__ = make(orig, cls, attrs)
+        patched.append((cls, orig))
+    try:
+        yield
+    finally:
+        for cls, orig in patched:
+            cls.__init__ = orig
+
+
+# ---------------------------------------------------------------------------
+# Thread-leak watchdog
+# ---------------------------------------------------------------------------
+_DEFAULT_ALLOW = (
+    "pydevd", "IPython", "pytest-",        # tooling
+    "ThreadPoolExecutor",                  # jax internal pools
+    "jax_",
+)
+
+
+def _thread_leaks(before: set, grace_s: float,
+                  allow: Sequence[str]) -> List[str]:
+    deadline = time.monotonic() + grace_s
+    while True:
+        new = [t for t in threading.enumerate()
+               if t not in before and t.is_alive()
+               and not any(p in (t.name or "") for p in allow)]
+        if not new or time.monotonic() >= deadline:
+            return [f"{t.name} (daemon={t.daemon})" for t in new]
+        for t in new:
+            t.join(timeout=max(0.01, deadline - time.monotonic()))
+
+
+# ---------------------------------------------------------------------------
+# The context manager
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def sanitize(tracer_leaks: bool = False, debug_nans: bool = False,
+             thread_watchdog: bool = True, lock_order: bool = True,
+             grace_s: float = 5.0,
+             allow_threads: Sequence[str] = (),
+             raise_on_violation: bool = True):
+    """Run a block under the runtime sanitizers; yields a
+    SanitizerReport filled in at exit. `allow_threads` name-substrings
+    are ADDED to the built-in allowlist (tooling/jax pools) — use it for
+    threads owned by longer-lived fixtures that legitimately outlive one
+    sanitized block. See module docstring."""
+    report = SanitizerReport()
+    allow_threads = tuple(_DEFAULT_ALLOW) + tuple(allow_threads)
+    jax_restore = []
+    if tracer_leaks or debug_nans:
+        import jax
+        for flag, on in (("jax_check_tracer_leaks", tracer_leaks),
+                         ("jax_debug_nans", debug_nans)):
+            if on:
+                jax_restore.append((flag, bool(getattr(jax.config, flag))))
+                jax.config.update(flag, True)
+    before = set(threading.enumerate()) if thread_watchdog else set()
+    watch = LockOrderWatch() if lock_order else None
+    ctx = _patched_lock_order(watch) if lock_order \
+        else contextlib.nullcontext()
+    try:
+        with ctx:
+            yield report
+    finally:
+        if jax_restore:
+            import jax
+            for flag, old in jax_restore:
+                jax.config.update(flag, old)
+        if thread_watchdog:
+            report.started_threads = sum(
+                1 for t in threading.enumerate() if t not in before)
+            report.leaked_threads = _thread_leaks(before, grace_s,
+                                                  allow_threads)
+        if watch is not None:
+            report.checked_locks = watch.wrapped
+            report.lock_violations = list(watch.violations)
+    if raise_on_violation:
+        if report.lock_violations:
+            raise LockOrderError("; ".join(report.lock_violations))
+        if report.leaked_threads:
+            raise ThreadLeakError(
+                "threads leaked past the sanitized block (grace "
+                f"{grace_s:.1f}s): {', '.join(report.leaked_threads)} — "
+                "every subsystem must join/close its workers "
+                "(close()/stop()/shutdown())")

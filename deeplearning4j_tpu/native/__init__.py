@@ -129,7 +129,10 @@ def _load() -> Optional[ctypes.CDLL]:
             src_mtime = os.path.getmtime(_SRC)
             if not os.path.exists(path) \
                     or os.path.getmtime(path) < src_mtime:
-                if not _build(path):
+                # the one-time cc build MUST complete under _LOCK:
+                # concurrent importers have nothing to do until the
+                # artifact exists, and exactly-once is the point
+                if not _build(path):  # graftlint: disable=blocking-call-under-lock
                     return None
             lib = ctypes.CDLL(path)
             _bind(lib)

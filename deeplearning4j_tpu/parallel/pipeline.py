@@ -295,7 +295,7 @@ class PipelinedNetworkTrainer:
                 gp, gx = vjp((cot, jax.tree_util.tree_map(jnp.zeros_like,
                                                           new_state)))
                 return gp, gx, new_state
-            jits.append(jax.jit(bwd))
+            jits.append(jax.jit(bwd))  # one jit per stage, built once  # graftlint: disable=jit-in-loop
         return jits
 
     @functools.cached_property
@@ -343,7 +343,7 @@ class PipelinedNetworkTrainer:
                     if p:
                         total = total + layer.reg_score(p)
                 return total
-            jits.append(jax.jit(jax.value_and_grad(reg)))
+            jits.append(jax.jit(jax.value_and_grad(reg)))  # graftlint: disable=jit-in-loop
         return jits
 
     @functools.cached_property
@@ -361,7 +361,7 @@ class PipelinedNetworkTrainer:
                 p, o = self.model.apply_layer_updates(
                     _layers, params, grads, opt, step)
                 return tuple(p), tuple(o)
-            jits.append(jax.jit(upd))
+            jits.append(jax.jit(upd))  # per-stage, cached  # graftlint: disable=jit-in-loop
         return jits
 
     # -- training --------------------------------------------------------
@@ -690,7 +690,7 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     if p:
                         total = total + conf.vertices[n].reg_score(p)
                 return total
-            jits.append(jax.jit(jax.value_and_grad(reg)))
+            jits.append(jax.jit(jax.value_and_grad(reg)))  # graftlint: disable=jit-in-loop
         return jits
 
     @functools.cached_property
@@ -738,7 +738,7 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     new_p[n] = jax.tree_util.tree_map(
                         lambda a, u_: a - u_, p, updates)
                 return new_p, new_o
-            jits.append(jax.jit(upd))
+            jits.append(jax.jit(upd))  # per-stage, cached  # graftlint: disable=jit-in-loop
         return jits
 
     def sync_back(self):

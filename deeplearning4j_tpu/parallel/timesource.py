@@ -157,6 +157,10 @@ class CoordinatorTimeSource(TimeSource):
         return ((t1 - t0) + (t2 - t3)) / 2.0, (t3 - t0) - (t2 - t1)
 
     def _refresh(self):
+        # the network exchange runs with NO lock held (graftlint:
+        # blocking-call-under-lock) — only the publish of the measured
+        # offset takes the lock, so concurrent offset_ms() readers are
+        # never stalled behind a slow/unreachable time server
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout) as sock:
             best = None
@@ -164,8 +168,9 @@ class CoordinatorTimeSource(TimeSource):
                 off, delay = self._measure_once(sock)
                 if best is None or delay < best[1]:
                     best = (off, delay)
-        self._offset = best[0]
-        self._measured_at = self._clock()
+        with self._lock:
+            self._offset = best[0]
+            self._measured_at = self._clock()
 
     def offset_ms(self) -> float:
         """Current offset. The first measurement happened in __init__
@@ -173,16 +178,25 @@ class CoordinatorTimeSource(TimeSource):
         Refreshes run on a background thread while the STALE offset keeps
         being served, and a refresh failure logs and keeps the last good
         value (reference behavior) — a dead time server can never crash
-        the training loop or stall the stats hot path."""
+        the training loop or stall the stats hot path. The lock is held
+        only for the state reads/flag flip; network I/O (the defensive
+        re-measure included) always happens outside it."""
         with self._lock:
-            if self._offset is None:   # defensive; __init__ measures
-                self._refresh()
-            elif (self._clock() - self._measured_at > self.frequency_sec
-                    and not getattr(self, "_refreshing", False)):
+            offset = self._offset
+            spawn = (offset is not None
+                     and self._clock() - self._measured_at
+                     > self.frequency_sec
+                     and not self._refreshing)
+            if spawn:
                 self._refreshing = True
-                threading.Thread(target=self._refresh_bg,
-                                 daemon=True).start()
-            return self._offset * 1000.0
+        if offset is None:            # defensive; __init__ measures
+            self._refresh()
+            with self._lock:
+                offset = self._offset
+        elif spawn:
+            threading.Thread(target=self._refresh_bg,
+                             daemon=True).start()
+        return offset * 1000.0
 
     def _refresh_bg(self):
         import logging
@@ -192,8 +206,9 @@ class CoordinatorTimeSource(TimeSource):
             logging.getLogger("deeplearning4j_tpu").warning(
                 "time-source refresh failed (keeping stale offset "
                 "%.1f ms): %s", (self._offset or 0.0) * 1e3, e)
-            # back off a full period before retrying
-            self._measured_at = self._clock()
+            with self._lock:
+                # back off a full period before retrying
+                self._measured_at = self._clock()
         finally:
             self._refreshing = False
 

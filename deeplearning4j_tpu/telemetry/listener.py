@@ -51,14 +51,19 @@ class TelemetryListener(TrainingListener):
         self._last = now
         self._iters.inc()
         self._samples.inc(max(0, int(getattr(model, "last_batch_size", 0))))
-        try:
-            self._score.set(float(model.score()))
-        except (TypeError, ValueError):
-            pass
         rc = getattr(model, "recompile_count", None)
         if rc is not None:
             self._recompiles.set(int(rc))
         if iteration % self.report_window == 0:
+            # the score gauge is read HERE, on the report window, not per
+            # step: model.score() materializes the step's device score
+            # (float() -> device->host sync), and doing that every
+            # iteration re-serializes the async dispatch pipeline the
+            # whole fit path is built around (graftlint: hot-loop-sync)
+            try:
+                self._score.set(float(model.score()))
+            except (TypeError, ValueError):
+                pass
             self.session.watermarks.sample()
 
     def on_epoch_start(self, model):

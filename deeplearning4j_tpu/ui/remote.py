@@ -60,19 +60,40 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         # (pending+1) timeouts — the drain stops at the first failure.
         with self._lock:
             self._retry.append(payload)
-        # the drain itself is serialized: without this, two callers could
-        # both read the same head and POST it twice before either pops it
-        with self._drain_lock:
-            while True:
-                with self._lock:
-                    if not self._retry:
-                        return
-                    head = self._retry[0]
-                if not self._post(head):
+        self._try_drain()
+
+    def _try_drain(self):
+        """Drain the retry queue from the head, serialized by a TRY-lock
+        (two drainers could read the same head and POST it twice) — a
+        caller never BLOCKS on the drain lock: stalling a training
+        thread behind someone else's slow POST for a full HTTP timeout
+        is exactly what graftlint's blocking-call-under-lock flags. A
+        failed try-acquire means an active drainer exists; it delivers
+        late enqueues via its inner loop, and the post-release re-check
+        below closes the remaining window (an append landing between
+        the drainer's final empty-check and its release)."""
+        while True:
+            if not self._drain_lock.acquire(blocking=False):
+                return
+            try:
+                while True:
+                    with self._lock:
+                        if not self._retry:
+                            break
+                        head = self._retry[0]
+                    if not self._post(head):
+                        return      # head retried on the next cycle
+                    with self._lock:
+                        if self._retry and self._retry[0] is head:
+                            self._retry.popleft()
+            finally:
+                self._drain_lock.release()
+            # re-check AFTER releasing: a payload enqueued during the
+            # final empty-check window must not strand until the next
+            # put_update (it may be the run's last stats report)
+            with self._lock:
+                if not self._retry:
                     return
-                with self._lock:
-                    if self._retry and self._retry[0] is head:
-                        self._retry.popleft()
 
     @property
     def pending(self) -> int:

@@ -19,8 +19,21 @@
 #                            8-device virtual mesh plus one scaling_bench
 #                            rep with the paired replicated-vs-ZeRO
 #                            ablation (prints the efficiency JSON line)
+#   ./runtests.sh lint       graftlint static pass (jit/tracer hygiene,
+#                            recompile hazards, donation safety,
+#                            concurrency lint) against the checked-in
+#                            baseline — any NON-baselined finding fails —
+#                            plus the analysis self-tests and runtime-
+#                            sanitizer smoke. The same gate runs inside
+#                            the full suite via tests/test_analysis.py.
 set -euo pipefail
 cd "$(dirname "$0")"
+if [[ "${1:-}" == "lint" ]]; then
+    echo "=== graftlint static pass (baseline: graftlint_baseline.json) ==="
+    python -m tools.graftlint deeplearning4j_tpu/
+    echo "=== analysis self-tests + runtime sanitizer smoke ==="
+    exec python -m pytest tests/test_analysis.py -q
+fi
 if [[ "${1:-}" == "serving" ]]; then
     echo "=== serving smoke ==="
     python -m pytest tests/test_serving.py -q

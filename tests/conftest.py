@@ -31,6 +31,26 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (multi-process spawns etc.)")
+    config.addinivalue_line(
+        "markers",
+        "sanitize(**kwargs): run the test under "
+        "analysis.sanitizer.sanitize — thread-leak watchdog + "
+        "order-asserting lock shims by default; kwargs forwarded "
+        "(tracer_leaks=, debug_nans=, grace_s=, ...)")
+
+
+@pytest.fixture(autouse=True)
+def _graftlint_sanitize(request):
+    """The `sanitize` pytest marker: wraps the marked test in the
+    graftlint runtime sanitizer (see analysis/sanitizer.py). Violations
+    surface as test errors at teardown."""
+    m = request.node.get_closest_marker("sanitize")
+    if m is None:
+        yield
+        return
+    from deeplearning4j_tpu.analysis.sanitizer import sanitize
+    with sanitize(**m.kwargs):
+        yield
 
 
 @pytest.fixture

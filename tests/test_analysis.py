@@ -1,0 +1,896 @@
+"""graftlint self-tests (ISSUE 9).
+
+Fixture-driven: every rule family has a known-bad snippet that MUST fire
+and a known-clean snippet that MUST stay quiet, plus pragma suppression,
+baseline round-trip, the whole-package self-hosting gate (this test IS
+the CI step — a new non-baselined finding fails tier-1), the runtime
+sanitizer, and regression tests for the real bugs the pass surfaced:
+
+  * telemetry/listener.py  — hot-loop-sync: TelemetryListener pulled
+    float(model.score()) on EVERY iteration (a per-step device->host
+    sync serializing the async dispatch pipeline); now gated on the
+    report window.
+  * parallel/timesource.py — blocking-call-under-lock:
+    CoordinatorTimeSource.offset_ms could run the NTP network exchange
+    while holding its lock, stalling every concurrent stats reader
+    behind a 5 s socket timeout; refresh now runs lock-free.
+  * ui/remote.py           — blocking-call-under-lock:
+    RemoteUIStatsStorageRouter.put_update drained the retry queue
+    (HTTP POST, up to a full timeout) under a blocking lock; the drain
+    now try-locks so a training thread never stalls behind another's
+    slow POST.
+"""
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (Finding, LockOrderError,
+                                         ThreadLeakError, run_lint,
+                                         sanitize)
+from deeplearning4j_tpu.analysis.engine import (baseline_diff,
+                                                load_baseline,
+                                                write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "deeplearning4j_tpu")
+BASELINE = os.path.join(REPO, "graftlint_baseline.json")
+
+
+def lint_src(tmp_path, src, name="snippet.py", baseline=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return run_lint([str(p)], baseline_path=baseline)
+
+
+def rules_of(result):
+    return {f.rule for f in result.findings}
+
+
+# ---------------------------------------------------------------------------
+# Family JH: jit/tracer hygiene
+# ---------------------------------------------------------------------------
+def test_host_sync_in_trace_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            y = jnp.sin(x)
+            return float(y)
+
+        f = jax.jit(step)
+    """)
+    assert "host-sync-in-trace" in rules_of(res)
+
+
+def test_host_sync_item_and_numpy_fire(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def step(x):
+            y = jnp.sum(x)
+            a = y.item()
+            b = np.asarray(y)
+            return a, b
+
+        f = jax.jit(step)
+    """)
+    assert sum(f.rule == "host-sync-in-trace"
+               for f in res.findings) == 2
+
+
+def test_host_sync_quiet_on_static_values(tmp_path):
+    # float() on a static scalar / shape element is fine under trace
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, eps):
+            n = float(x.shape[0])
+            e = float(eps)
+            return jnp.sum(x) / n + e
+
+        f = jax.jit(step)
+    """)
+    assert "host-sync-in-trace" not in rules_of(res)
+
+
+def test_print_wallclock_rng_fire(tmp_path):
+    res = lint_src(tmp_path, """
+        import time
+        import random
+        import jax
+
+        def step(x):
+            print("debug")
+            t = time.time()
+            r = random.random()
+            return x
+
+        f = jax.jit(step)
+    """)
+    got = rules_of(res)
+    assert {"print-in-trace", "wallclock-in-trace",
+            "python-rng-in-trace"} <= got
+
+
+def test_hygiene_quiet_outside_trace(tmp_path):
+    # identical body, never jitted -> host code may do all of this
+    res = lint_src(tmp_path, """
+        import time
+        import random
+
+        def host_step(x):
+            print("debug")
+            t = time.time()
+            r = random.random()
+            return float(x)
+    """)
+    assert not rules_of(res) & {"print-in-trace", "wallclock-in-trace",
+                                "python-rng-in-trace",
+                                "host-sync-in-trace"}
+
+
+def test_traced_value_branch_fires_and_shields(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def bad(x):
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+
+        def ok(x, train):
+            if train:                    # static config param
+                x = x * 2
+            if x.ndim == 3:              # shape shield
+                x = x[0]
+            if x is None:                # None shield
+                return x
+            return jnp.sum(x)
+
+        f = jax.jit(bad)
+        g = jax.jit(ok)
+    """)
+    fired = [f for f in res.findings if f.rule == "traced-value-branch"]
+    assert len(fired) == 1 and fired[0].scope.endswith(":bad")
+
+
+def test_trace_reaches_through_calls_and_scan(tmp_path):
+    # helper reached FROM a jitted fn, and a lax.scan body, are traced
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            y = jnp.exp(x)
+            return float(y)
+
+        def step(x):
+            return helper(x)
+
+        def body(carry, x):
+            z = jnp.add(carry, x)
+            return carry, z.item()
+
+        f = jax.jit(step)
+
+        def run(xs):
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+    scopes = {f.scope for f in res.findings
+              if f.rule == "host-sync-in-trace"}
+    assert any(s.endswith(":helper") for s in scopes)
+    assert any(s.endswith(":body") for s in scopes)
+
+
+def test_hot_loop_sync_fires_unguarded_only(tmp_path):
+    res = lint_src(tmp_path, """
+        class Bad:
+            def iteration_done(self, model, iteration):
+                self.score = float(model.score())
+
+        class Guarded:
+            def iteration_done(self, model, iteration):
+                if iteration % 10 == 0:
+                    self.score = float(model.score())
+
+        class EarlyReturn:
+            def iteration_done(self, model, iteration):
+                if iteration % self.freq != 0:
+                    return
+                self.score = float(model.score())
+    """)
+    fired = [f for f in res.findings if f.rule == "hot-loop-sync"]
+    assert len(fired) == 1 and "Bad" in fired[0].scope
+
+
+def test_taint_propagates_through_derived_locals(tmp_path):
+    """Review regression: values one assignment away from a jnp result
+    must still be tainted (the first cut visited statements in stack
+    order, so `b = a + 1` was scanned before `a` was tainted)."""
+    res = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            a = jnp.sum(x)
+            b = a + 1
+            if b > 0:
+                return float(b)
+            return b
+
+        f = jax.jit(step)
+    """)
+    got = rules_of(res)
+    assert "traced-value-branch" in got and "host-sync-in-trace" in got
+
+
+# ---------------------------------------------------------------------------
+# Family RC: recompilation hazards
+# ---------------------------------------------------------------------------
+def test_jit_in_loop_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def rebuild_every_call(fns, x):
+            outs = []
+            for fn in fns:
+                outs.append(jax.jit(fn)(x))
+            return outs
+    """)
+    assert "jit-in-loop" in rules_of(res)
+
+
+def test_jit_outside_loop_quiet(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def step(x):
+            return x
+
+        f = jax.jit(step)
+    """)
+    assert "jit-in-loop" not in rules_of(res)
+
+
+def test_unhashable_static_arg_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def fn(x, opts):
+            return x
+
+        g = jax.jit(fn, static_argnums=(1,))
+
+        def call(x):
+            return g(x, [1, 2])
+
+        def call_ok(x):
+            return g(x, (1, 2))
+    """)
+    fired = [f for f in res.findings
+             if f.rule == "unhashable-static-arg"]
+    assert len(fired) == 1
+
+
+def test_shape_branch_fires_on_variable_comparison(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def bad(x, budget):
+            if x.shape[0] > budget:
+                return x
+            return x
+
+        def ok(x):
+            if x.ndim == 3:
+                return x[0]
+            return x
+
+        f = jax.jit(bad)
+        g = jax.jit(ok)
+    """)
+    fired = [f for f in res.findings
+             if f.rule == "shape-branch-in-trace"]
+    assert len(fired) == 1 and fired[0].scope.endswith(":bad")
+
+
+def test_unwatched_jit_entry_cross_check(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+        from deeplearning4j_tpu.telemetry.compile_watch import watch_compiles
+
+        def a(x):
+            return x
+
+        def b(x):
+            return x
+
+        covered = watch_compiles(jax.jit(a), "test/a")
+        uncovered = jax.jit(b)
+    """)
+    fired = [f for f in res.findings if f.rule == "unwatched-jit-entry"]
+    assert len(fired) == 1
+    assert "uncovered" in fired[0].snippet
+
+
+def test_record_aot_comment_does_not_exempt(tmp_path):
+    """Review regression: only an actual record_aot CALL exempts a
+    module's jit sites from unwatched-jit-entry — a comment mentioning
+    it must not bypass the gate."""
+    commented = lint_src(tmp_path, """
+        import jax
+        # TODO: maybe use record_aot here someday
+
+        def step(x):
+            return x
+
+        f = jax.jit(step)
+    """)
+    assert "unwatched-jit-entry" in rules_of(commented)
+    calling = lint_src(tmp_path, """
+        import jax
+
+        def step(x):
+            return x
+
+        def build(tel):
+            f = jax.jit(step)
+            tel.compiles.record_aot("mod/step", 0.1)
+            return f
+    """, name="snippet2.py")
+    assert "unwatched-jit-entry" not in rules_of(calling)
+
+
+def test_rules_filter_uses_filtered_baseline(tmp_path):
+    """Review regression: a --rules-restricted run must not report other
+    rules' baseline entries as stale (or as anything at all)."""
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(BAD_SLEEP.format(pragma="")),
+                 encoding="utf-8")
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), run_lint([str(p)]).findings + [
+        Finding("unwatched-jit-entry", "other.py", 1, 0, "m",
+                scope="s", snippet="g = jax.jit(f)")])
+    res = run_lint([str(p)], baseline_path=str(bl),
+                   rules=["blocking-call-under-lock"])
+    assert not res.new and not res.stale_baseline
+
+
+def test_tools_wrapper_imports_without_jax():
+    """Review regression: `python -m tools.graftlint` must not pull in
+    jax / the package __init__ — the engine is pure stdlib."""
+    import subprocess
+    code = ("import sys; sys.path.insert(0, %r); "
+            "import tools.graftlint as g; "
+            "rc = g.main([%r, '--baseline', %r]); "
+            "assert 'jax' not in sys.modules, 'jax was imported'; "
+            "sys.exit(rc)" % (REPO, PKG, BASELINE))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env={**os.environ, "PYTHONPATH": ""})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Family DN: donation safety
+# ---------------------------------------------------------------------------
+def test_donated_buffer_reuse_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def step(p, x):
+            return p
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(p, x):
+            out = f(p, x)
+            return p + out
+    """)
+    fired = [f for f in res.findings if f.rule == "donated-buffer-reuse"]
+    assert len(fired) == 1 and "'p'" in fired[0].message
+
+
+def test_donated_rebind_is_quiet(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def step(p, x):
+            return p
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(p, xs):
+            for x in xs:
+                p = f(p, x)
+            return p
+    """)
+    assert "donated-buffer-reuse" not in rules_of(res)
+
+
+def test_donated_loop_carry_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import jax
+
+        def step(p, x):
+            return p
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(p, xs):
+            outs = []
+            for x in xs:
+                outs.append(f(p, x))
+            return outs
+    """)
+    assert "donated-buffer-reuse" in rules_of(res)
+
+
+# ---------------------------------------------------------------------------
+# Family CC: concurrency
+# ---------------------------------------------------------------------------
+def test_blocking_under_lock_fires_direct_and_transitive(tmp_path):
+    res = lint_src(tmp_path, """
+        import threading
+        import time
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_direct(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def _slow(self):
+                time.sleep(1.0)
+
+            def bad_transitive(self):
+                with self._lock:
+                    self._slow()
+
+            def ok(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1.0)
+                return x
+    """)
+    fired = [f for f in res.findings
+             if f.rule == "blocking-call-under-lock"]
+    assert {f.scope.split(".")[-1] for f in fired} == \
+        {"bad_direct", "bad_transitive"}
+
+
+def test_blocking_with_statement_under_lock_fires(tmp_path):
+    """Review regression: `with socket.create_connection(...)` under a
+    held lock must be flagged like the plain-call form (the codebase's
+    own NTP-exchange idiom)."""
+    res = lint_src(tmp_path, """
+        import socket
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    with socket.create_connection(("h", 1)) as s:
+                        s.sendall(b"x")
+
+            def ok(self):
+                with socket.create_connection(("h", 1)) as s:
+                    s.sendall(b"x")
+    """)
+    fired = [f for f in res.findings
+             if f.rule == "blocking-call-under-lock"]
+    assert len(fired) == 1 and fired[0].scope.endswith(".bad")
+
+
+def test_lock_order_cycle_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import threading
+
+        class B:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def one(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+            def two(self):
+                with self.lock_b:
+                    with self.lock_a:
+                        pass
+    """)
+    assert "lock-order-cycle" in rules_of(res)
+
+
+def test_consistent_lock_order_quiet(tmp_path):
+    res = lint_src(tmp_path, """
+        import threading
+
+        class B:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def one(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+            def two(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+    """)
+    assert "lock-order-cycle" not in rules_of(res)
+
+
+def test_unlocked_global_mutation_fires(tmp_path):
+    res = lint_src(tmp_path, """
+        import threading
+
+        _events = []
+        _lock = threading.Lock()
+
+        def worker():
+            _events.append(1)
+
+        def worker_ok():
+            with _lock:
+                _events.append(1)
+
+        threading.Thread(target=worker).start()
+        threading.Thread(target=worker_ok).start()
+    """)
+    fired = [f for f in res.findings
+             if f.rule == "unlocked-global-mutation"]
+    assert len(fired) == 1 and fired[0].scope.endswith(":worker")
+
+
+# ---------------------------------------------------------------------------
+# Pragmas + baseline workflow
+# ---------------------------------------------------------------------------
+BAD_SLEEP = """
+    import threading
+    import time
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0){pragma}
+"""
+
+
+def test_inline_pragma_suppresses(tmp_path):
+    noisy = lint_src(tmp_path, BAD_SLEEP.format(pragma=""))
+    assert "blocking-call-under-lock" in rules_of(noisy)
+    quiet = lint_src(
+        tmp_path, BAD_SLEEP.format(
+            pragma="  # graftlint: disable=blocking-call-under-lock"),
+        name="snippet2.py")
+    assert "blocking-call-under-lock" not in rules_of(quiet)
+
+
+def test_file_pragma_and_wildcard(tmp_path):
+    src = "# graftlint: disable-file=blocking-call-under-lock\n" \
+        + textwrap.dedent(BAD_SLEEP.format(pragma=""))
+    p = tmp_path / "filelevel.py"
+    p.write_text(src, encoding="utf-8")
+    res = run_lint([str(p)])
+    assert "blocking-call-under-lock" not in rules_of(res)
+    src2 = textwrap.dedent(BAD_SLEEP.format(
+        pragma="  # graftlint: disable=*"))
+    p2 = tmp_path / "wildcard.py"
+    p2.write_text(src2, encoding="utf-8")
+    assert "blocking-call-under-lock" not in rules_of(run_lint([str(p2)]))
+
+
+def test_baseline_round_trip(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(BAD_SLEEP.format(pragma="")),
+                 encoding="utf-8")
+    res = run_lint([str(p)])
+    assert res.findings and res.new == res.findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), res.findings)
+    res2 = run_lint([str(p)], baseline_path=str(bl))
+    assert res2.findings and not res2.new        # fully baselined
+    # a NEW finding (another blocking call) is not covered
+    extra = ("\n    def bad2(self):\n"
+             "        with self._lock:\n"
+             "            time.sleep(2.0)\n")
+    p.write_text(p.read_text() + extra, encoding="utf-8")
+    res3 = run_lint([str(p)], baseline_path=str(bl))
+    assert len(res3.new) == 1
+    # line drift does NOT invalidate the baseline (key is line-free)
+    moved = "x = 1\n" + textwrap.dedent(BAD_SLEEP.format(pragma=""))
+    p.write_text(moved, encoding="utf-8")
+    res4 = run_lint([str(p)], baseline_path=str(bl))
+    assert not res4.new
+
+
+def test_baseline_counts_ratchet():
+    f = lambda: Finding("r", "a.py", 3, 0, "m", scope="s", snippet="x()")
+    two = [f(), f()]
+    bl = {two[0].key(): 1}
+    new, stale = baseline_diff(two, bl)
+    assert len(new) == 1                         # second copy is new
+    new, stale = baseline_diff([f()], {f().key(): 2})
+    assert not new and stale                     # over-budgeted -> stale
+
+
+# ---------------------------------------------------------------------------
+# Self-hosting: the CI gate
+# ---------------------------------------------------------------------------
+def test_whole_package_clean_vs_baseline_under_30s():
+    t0 = time.perf_counter()
+    res = run_lint([PKG], baseline_path=BASELINE)
+    wall = time.perf_counter() - t0
+    assert wall < 30.0, f"graftlint took {wall:.1f}s on the package"
+    assert res.files > 100
+    msg = "\n".join(f.render() for f in res.new)
+    assert not res.new, f"new graftlint findings (fix or baseline):\n{msg}"
+    # the three fixed bugs must STAY fixed (no baseline entry hides them)
+    for key in load_baseline(BASELINE):
+        assert "hot-loop-sync" not in key, key
+        assert "blocking-call-under-lock" not in key, key
+
+
+def test_cli_metrics_mode():
+    from deeplearning4j_tpu.analysis.cli import lint_metrics, main
+    m = lint_metrics([PKG], baseline=BASELINE)
+    assert m["new"] == 0 and m["total"] >= 0 and m["files"] > 100
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([PKG, "--baseline", BASELINE, "--metrics"])
+    assert rc == 0
+    text = buf.getvalue()
+    assert "dl4j_lint_findings_total{" in text
+    assert "dl4j_lint_files_total" in text
+
+
+def test_cli_exit_codes(tmp_path):
+    from deeplearning4j_tpu.analysis.cli import main
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BAD_SLEEP.format(pragma="")),
+                 encoding="utf-8")
+    import contextlib
+    import io
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert main([str(p), "--no-baseline"]) == 1
+        bl = tmp_path / "bl.json"
+        assert main([str(p), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        assert main([str(p), "--baseline", str(bl)]) == 0
+        assert main([str(tmp_path / "missing.py")]) == 2
+    # review regression: a rule-filtered run must NEVER overwrite the
+    # baseline (it would erase every other rule's accepted entries)
+    with pytest.raises(SystemExit):
+        with contextlib.redirect_stdout(io.StringIO()), \
+                contextlib.redirect_stderr(io.StringIO()):
+            main([str(p), "--baseline", str(bl), "--rules",
+                  "jit-in-loop", "--write-baseline"])
+    assert load_baseline(str(bl))                # untouched
+
+
+# ---------------------------------------------------------------------------
+# Runtime sanitizer
+# ---------------------------------------------------------------------------
+def test_thread_watchdog_catches_leak():
+    stop = threading.Event()
+    with pytest.raises(ThreadLeakError, match="leaky-worker"):
+        with sanitize(thread_watchdog=True, lock_order=False,
+                      grace_s=0.2):
+            threading.Thread(target=stop.wait, name="leaky-worker",
+                             daemon=True).start()
+    stop.set()
+
+
+def test_thread_watchdog_passes_joined_threads():
+    with sanitize(thread_watchdog=True, lock_order=False, grace_s=2.0):
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+
+
+def test_lock_order_shim_detects_inversion():
+    from deeplearning4j_tpu.analysis.sanitizer import (LockOrderWatch,
+                                                       OrderCheckedLock)
+    watch = LockOrderWatch()
+    a = OrderCheckedLock(threading.Lock(), "A", watch)
+    b = OrderCheckedLock(threading.Lock(), "B", watch)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                       # inversion of the recorded order
+            pass
+    assert watch.violations and "A" in watch.violations[0]
+
+
+def test_sanitize_raises_lock_order_error():
+    """Inverted acquisition on the serving plane's wrapped locks is
+    caught by the sanitizer's own watch and raised at block exit."""
+    from deeplearning4j_tpu.serving.registry import ModelRegistry, _Entry
+    with pytest.raises(LockOrderError):
+        with sanitize(thread_watchdog=False, lock_order=True):
+            reg = ModelRegistry()
+            entry = _Entry()
+            with reg._lock:
+                with entry.swap_lock:
+                    pass
+            with entry.swap_lock:
+                with reg._lock:          # inversion
+                    pass
+
+
+def test_sanitize_wraps_serving_registry_locks():
+    from deeplearning4j_tpu.analysis.sanitizer import OrderCheckedLock
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    with sanitize(thread_watchdog=False, lock_order=True):
+        reg = ModelRegistry()
+        assert isinstance(reg._lock, OrderCheckedLock)
+        assert reg.names() == []      # proxy works as a context manager
+    reg2 = ModelRegistry()
+    assert not isinstance(reg2._lock, OrderCheckedLock)  # patch restored
+
+
+def test_sanitize_restores_jax_flags():
+    import jax
+    before = bool(jax.config.jax_check_tracer_leaks)
+    with sanitize(tracer_leaks=True, thread_watchdog=False,
+                  lock_order=False):
+        assert bool(jax.config.jax_check_tracer_leaks) is True
+    assert bool(jax.config.jax_check_tracer_leaks) == before
+
+
+@pytest.mark.sanitize(tracer_leaks=True)
+def test_sanitize_marker_smoke():
+    """The conftest marker wires the sanitizer around this test: a small
+    jitted computation under tracer-leak checking + thread watchdog."""
+    import jax
+    import jax.numpy as jnp
+    out = jax.jit(lambda x: jnp.sum(x * 2))(jnp.arange(8.0))
+    assert float(out) == 56.0
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# Regression tests for the real bugs graftlint surfaced
+# ---------------------------------------------------------------------------
+class _CountingScoreModel:
+    """Stands in for a network in the listener chain: counts how often a
+    listener forces score materialization."""
+
+    last_batch_size = 32
+    epoch_count = 0
+
+    def __init__(self):
+        self.score_calls = 0
+
+    def score(self):
+        self.score_calls += 1
+        return 1.25
+
+
+def test_telemetry_listener_score_sync_gated_on_window():
+    """Regression (rule: hot-loop-sync): TelemetryListener must NOT call
+    float(model.score()) — a device->host sync — on every iteration;
+    only on the report window."""
+    from deeplearning4j_tpu.telemetry import TelemetrySession
+    from deeplearning4j_tpu.telemetry.listener import TelemetryListener
+    from deeplearning4j_tpu.telemetry import runtime as tel_runtime
+
+    sess = TelemetrySession(report_window=10)
+    with tel_runtime.enabled(sess):
+        listener = TelemetryListener(session=sess)
+        model = _CountingScoreModel()
+        for it in range(1, 31):
+            listener.iteration_done(model, it)
+    assert model.score_calls == 3, \
+        f"score() pulled {model.score_calls}x in 30 iters (expected 3: " \
+        "once per report_window=10) — per-step host sync regressed"
+    # and the gauge still updates on the window
+    assert sess.registry.get("dl4j_score").value() == 1.25
+    # the static rule agrees: no hot-loop-sync finding in the listener
+    res = run_lint([os.path.join(PKG, "telemetry", "listener.py")])
+    assert "hot-loop-sync" not in rules_of(res)
+
+
+def test_timesource_refresh_never_runs_under_lock():
+    """Regression (rule: blocking-call-under-lock): offset_ms must not
+    hold the lock across the NTP socket exchange."""
+    from deeplearning4j_tpu.parallel.timesource import (
+        CoordinatorTimeSource, TimeServer)
+
+    with TimeServer() as srv:
+        src = CoordinatorTimeSource(srv.host, srv.port,
+                                    frequency_sec=10_000, samples=1)
+        orig = src._refresh
+        seen = []
+
+        def checked_refresh():
+            seen.append(src._lock.locked())
+            orig()
+
+        src._refresh = checked_refresh
+        src._offset = None                 # force the defensive path
+        assert isinstance(src.offset_ms(), float)
+        assert seen == [False], \
+            "offset_ms ran the network refresh while holding its lock"
+        # stale-offset path: background refresh, caller returns promptly
+        with src._lock:
+            src._measured_at = float("-inf")
+        t0 = time.perf_counter()
+        src.offset_ms()
+        assert time.perf_counter() - t0 < 2.0
+        for _ in range(200):               # let the bg thread finish
+            if not src._refreshing:
+                break
+            time.sleep(0.01)
+        assert seen.count(False) == len(seen)
+    # the static rule agrees
+    res = run_lint([os.path.join(PKG, "parallel", "timesource.py")])
+    assert "blocking-call-under-lock" not in rules_of(res)
+
+
+def test_remote_router_put_update_never_blocks_behind_slow_drain():
+    """Regression (rule: blocking-call-under-lock): a training thread's
+    put_update must not stall behind another thread's slow HTTP POST;
+    the active drainer delivers the late enqueue instead."""
+    from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
+
+    router = RemoteUIStatsStorageRouter("http://127.0.0.1:9")
+    posted = []
+    in_post, release = threading.Event(), threading.Event()
+
+    def fake_post(payload):
+        posted.append(payload["worker"])
+        if len(posted) == 1:
+            in_post.set()
+            assert release.wait(5.0)
+        return True
+
+    router._post = fake_post
+    t = threading.Thread(
+        target=lambda: router.put_update("s", "t", "w1", 1.0, {}),
+        daemon=True)
+    t.start()
+    assert in_post.wait(5.0)
+    t0 = time.perf_counter()
+    router.put_update("s", "t", "w2", 2.0, {})   # must NOT block
+    assert time.perf_counter() - t0 < 1.0, \
+        "put_update blocked behind another caller's POST"
+    release.set()
+    t.join(timeout=5.0)
+    for _ in range(200):
+        if len(posted) == 2 and not router.pending:
+            break
+        time.sleep(0.01)
+    assert posted == ["w1", "w2"]        # order preserved, both delivered
+    res = run_lint([os.path.join(PKG, "ui", "remote.py")])
+    assert "blocking-call-under-lock" not in rules_of(res)

@@ -18,6 +18,11 @@ import zipfile
 import numpy as np
 import pytest
 
+# graftlint runtime sanitizer (ISSUE 9): checkpoint/resume paths spawn
+# prefetch + GC work; the watchdog asserts clean thread shutdown.
+# debug_nans stays OFF here — this suite INJECTS NaNs deliberately.
+pytestmark = pytest.mark.sanitize
+
 from deeplearning4j_tpu import (Adam, ArrayDataSetIterator, ComputationGraph,
                                 DataSet, DenseLayer, InputType,
                                 ModelSerializer, MultiLayerNetwork,

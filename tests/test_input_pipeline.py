@@ -12,6 +12,10 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu import telemetry
+
+# graftlint runtime sanitizer (ISSUE 9): the async/prefetch iterators all
+# spawn worker threads — the watchdog asserts every test joins them
+pytestmark = pytest.mark.sanitize
 from deeplearning4j_tpu.datasets.iterators import (ArrayDataSetIterator,
                                                    AsyncDataSetIterator,
                                                    DataSet, DataSetIterator,

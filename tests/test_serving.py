@@ -18,6 +18,16 @@ from deeplearning4j_tpu.serving import (BatcherClosedError, DynamicBatcher,
                                         ServingError, UnknownModelError,
                                         cast_tree, quantize_tree)
 
+# graftlint runtime sanitizer (ISSUE 9): every test runs under the
+# thread-leak watchdog + order-asserting lock shims on the serving
+# plane's locks — a leaked batcher/HTTP worker or an inverted lock
+# acquisition fails the test at teardown. The module-scoped `served`
+# fixture's batcher is allowlisted: it starts lazily inside the first
+# test that predicts through it and legitimately lives until module
+# teardown (srv.stop() joins it there).
+pytestmark = pytest.mark.sanitize(
+    allow_threads=("dl4j-serving-batcher-tiny",))
+
 N_IN, N_OUT = 6, 3
 
 

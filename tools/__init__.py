@@ -1,0 +1,1 @@
+# repo-level developer tools (graftlint CLI lives here)

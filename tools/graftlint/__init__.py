@@ -1,0 +1,29 @@
+"""`python -m tools.graftlint` — wrapper over
+deeplearning4j_tpu.analysis.cli that does NOT execute the package's
+heavy `__init__` (jax + the full layer zoo): the lint engine is pure
+stdlib, so the CLI must start fast and work in environments without jax.
+
+If `deeplearning4j_tpu` is already imported, the normal module is used;
+otherwise a lightweight parent-package stub (real `__path__`, no
+`__init__` execution) lets `analysis.*` import by itself."""
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_cli():
+    if "deeplearning4j_tpu" not in sys.modules:
+        pkg_dir = os.path.join(_REPO, "deeplearning4j_tpu")
+        stub = types.ModuleType("deeplearning4j_tpu")
+        stub.__path__ = [pkg_dir]
+        stub.__file__ = os.path.join(pkg_dir, "__init__.py")
+        sys.modules["deeplearning4j_tpu"] = stub
+    from deeplearning4j_tpu.analysis import cli
+    return cli
+
+
+def main(argv=None):
+    return _load_cli().main(argv)
