@@ -213,6 +213,16 @@ def main():
     except Exception as e:
         extras["LeNet-ragged-pipeline"] = f"error: {type(e).__name__}"
     try:
+        # superstep before/after (ISSUE 11): per-batch-API LeNet fit with
+        # superstep=K (windows of K batches scanned in ONE jitted
+        # dispatch) vs superstep=1, alternating paired reps; reports the
+        # paired speedup and each path's device/dispatch span share —
+        # the same protocol/attribution as LeNet-ragged-pipeline
+        from deeplearning4j_tpu.models.zoo import bench_lenet_superstep
+        extras["LeNet-superstep"] = bench_lenet_superstep()
+    except Exception as e:
+        extras["LeNet-superstep"] = f"error: {type(e).__name__}"
+    try:
         w2v_cold, warms, w2v_tel = bench_word2vec()
         extras["Word2Vec-SGNS-words"] = round(w2v_cold, 1)
         warms = sorted(warms)

@@ -47,7 +47,7 @@ from .iterators import (AsyncDataSetIterator, DataSet, DataSetIterator,
                         MultiDataSet)
 
 __all__ = ["PadToBatchIterator", "DevicePrefetchIterator", "pad_dataset",
-           "pad_rows", "build_pipeline"]
+           "pad_rows", "build_pipeline", "stage_window", "batch_nbytes"]
 
 
 # ---------------------------------------------------------------------------
@@ -300,6 +300,46 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
             return super()._fetch()
         with m[1].time():
             return super()._fetch()
+
+
+# ---------------------------------------------------------------------------
+# Superstep window staging
+# ---------------------------------------------------------------------------
+def stage_window(batch_trees):
+    """Stack a superstep window's per-batch pytrees (tuples/dicts of
+    arrays, with None leaves for absent masks) along a new leading window
+    axis — the [K, batch, ...] input of the jitted superstep
+    (`nn/superstep.py`).
+
+    None leaves stay None, so the scan body sees the same static absence
+    the per-batch train step does. Host numpy batches pay ONE fused
+    host->device transfer for the whole window; batches a
+    `DevicePrefetchIterator` already staged on device stack with a device
+    op instead of a second H2D copy. Under the pipelined superstep loop
+    this call runs while the PREVIOUS window computes, so the transfer
+    overlaps device compute exactly like the per-batch prefetch did."""
+    import jax
+    import jax.numpy as jnp
+
+    def stack(*leaves):
+        return None if leaves[0] is None else jnp.stack(leaves)
+
+    return jax.tree_util.tree_map(stack, *batch_trees,
+                                  is_leaf=lambda x: x is None)
+
+
+def batch_nbytes(arrays) -> int:
+    """Byte size of one batch's arrays (None entries skipped) WITHOUT
+    materializing device buffers on host — `superstep="auto"` window
+    sizing reads shapes/dtypes only."""
+    total = 0
+    for a in arrays:
+        shape = getattr(a, "shape", None)
+        if a is None or shape is None:
+            continue
+        dt = np.dtype(getattr(a, "dtype", np.float32))
+        total += int(np.prod(shape)) * dt.itemsize
+    return total
 
 
 # ---------------------------------------------------------------------------

@@ -293,7 +293,17 @@ class FitCheckpointer:
             self.save()
 
     def on_batch(self):
-        self._batches += 1
+        self.on_batches(1)
+
+    def on_batches(self, n: int):
+        """Advance the batch cursor by a whole superstep window (n trained
+        batches) and act at the window EDGE: any deferred SIGTERM snapshot
+        and any due interval save fire here — the first boundary where the
+        model's state and the recorded `batches_into_epoch` agree. A
+        `checkpoint_every=` cadence therefore rounds up to superstep
+        edges; resume composes with any window length because window
+        grouping never changes the per-batch math (see nn/superstep.py)."""
+        self._batches += int(n)
         self._flush_sigterm()
         self.maybe_save()
 

@@ -10,6 +10,7 @@ MultiLayerNetwork: params/state are dicts keyed by vertex name, the whole DAG
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,6 +28,8 @@ from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
 from ..eval.evaluation import Evaluation
 from ..telemetry.compile_watch import watch_compiles
 from ..telemetry.runtime import active as _tel_active, null_span as _null_span
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["ComputationGraph"]
 
@@ -452,7 +455,7 @@ class ComputationGraph:
             s, _ = self._loss_fn(params, state, inputs, labels, None,
                                  fmasks=fmasks, lmasks=lmasks, train=False)
             return s
-        return jax.jit(score)
+        return watch_compiles(jax.jit(score), "graph/score")
 
     # ------------------------------------------------------------------
     # Data plumbing
@@ -480,7 +483,8 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     # Public API
     # ------------------------------------------------------------------
-    def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
+    def fit(self, data, epochs: int = 1, *, superstep=1,
+            prefetch: bool = False,
             pad_ragged: bool = False, time_buckets=None,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
             resume: bool = False, guard=None):
@@ -489,6 +493,13 @@ class ComputationGraph:
         (one train-step compile per fit, learning no-op); `prefetch` moves
         `device_tuple()` to a background thread one batch ahead so
         host->device transfer overlaps compute (see datasets/pipeline.py).
+
+        `superstep=K` (iterator inputs) runs windows of K batches as ONE
+        jitted `lax.scan` dispatch — bit-identical to the K=1 per-batch
+        loop, with listeners/guard/checkpoints firing at superstep edges
+        on the per-window loss vector (see nn/superstep.py). "auto" sizes
+        K from batch bytes, "epoch" windows the whole epoch. Line-search
+        optimizers fall back to per-batch dispatch.
 
         Fault-tolerance knobs (`checkpoint_dir`/`checkpoint_every`/
         `resume`/`guard`) behave exactly as on `MultiLayerNetwork.fit`:
@@ -503,6 +514,10 @@ class ComputationGraph:
                 raise ValueError(
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress)")
+            if superstep != 1:
+                log.info("superstep=%r ignored for a single-DataSet fit "
+                         "(one batch is one step); pass an iterator to "
+                         "window batches", superstep)
             if guard is not None:
                 guard.run_step(self, lambda: self._fit_batch(data))
             else:
@@ -516,25 +531,35 @@ class ComputationGraph:
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        if runner is not None:
+            runner.skip(skip)
+            skip = 0
+            if self.listeners:
+                from ..optimize.listeners import warn_scan_replay
+                warn_scan_replay(self.listeners)
         sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
                    else _null_span())
         try:
             with sigterm:
                 for _ in range(max(0, epochs - done_epochs)):
                     data.reset()
-                    while data.has_next():
-                        ds = (guard.next_batch(data) if guard is not None
-                              else data.next())
-                        if skip:
-                            skip -= 1   # resume: prefix already trained
-                            continue
-                        if guard is not None:
-                            guard.run_step(self,
-                                           lambda b=ds: self._fit_batch(b))
-                        else:
-                            self._fit_batch(ds)
-                        if ckpt is not None:
-                            ckpt.on_batch()
+                    if runner is not None:
+                        runner.run_epoch(data)
+                    else:
+                        while data.has_next():
+                            ds = (guard.next_batch(data) if guard is not None
+                                  else data.next())
+                            if skip:
+                                skip -= 1   # resume: prefix already trained
+                                continue
+                            if guard is not None:
+                                guard.run_step(self,
+                                               lambda b=ds: self._fit_batch(b))
+                            else:
+                                self._fit_batch(ds)
+                            if ckpt is not None:
+                                ckpt.on_batch()
                     self.epoch_count += 1
                     if ckpt is not None:
                         ckpt.on_epoch()
@@ -543,6 +568,35 @@ class ComputationGraph:
         finally:
             close()
         return self
+
+    def _make_superstep_runner(self, superstep, guard, ckpt):
+        """SuperstepRunner for this fit, or None for the per-batch loop
+        (superstep=1 or a line-search optimizer)."""
+        from .conf import OptimizationAlgorithm as OA
+        from .superstep import SuperstepRunner, validate_superstep
+
+        k = validate_superstep(superstep)
+        if k == 1:
+            return None
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            log.info("superstep=%r falls back to per-batch dispatch: "
+                     "line-search optimizers are per-batch sequential",
+                     superstep)
+            return None
+        return SuperstepRunner(self, _GraphSuperstepAdapter(self), k,
+                               guard=guard, ckpt=ckpt)
+
+    @_functools.cached_property
+    def _superstep_fn(self):
+        """Device-resident superstep: `lax.scan` of the graph train step
+        over a [K, batch, ...] window of stacked input/label dicts, RNG
+        chain threaded inside — bit-identical to the per-batch loop (see
+        nn/superstep.py)."""
+        from .superstep import build_superstep
+        return watch_compiles(
+            jax.jit(build_superstep(self.train_step_fn),
+                    donate_argnums=(0, 1, 2)),
+            "graph/superstep")
 
     @_functools.cached_property
     def _line_solver(self):
@@ -691,7 +745,7 @@ class ComputationGraph:
                 params, state, inputs, False, None, None,
                 stop_at_outputs=True, carries=carries)
             return self._collect_outputs(params, state, values), new_carries
-        return jax.jit(step)
+        return watch_compiles(jax.jit(step), "graph/rnn_step")
 
     def rnn_time_step(self, *features):
         """Feed one (or a few) timesteps through the graph, carrying hidden
@@ -765,7 +819,9 @@ class ComputationGraph:
 
     @functools.cached_property
     def _score_examples_fn(self):
-        return jax.jit(self.score_examples_fn, static_argnums=(6,))
+        return watch_compiles(
+            jax.jit(self.score_examples_fn, static_argnums=(6,)),
+            "graph/score_examples")
 
     def score_examples(self, data, add_regularization_terms: bool = True
                        ) -> np.ndarray:
@@ -843,3 +899,56 @@ class ComputationGraph:
             g._rng = self._rng
         g.iteration_count = self.iteration_count
         return g
+
+
+class _GraphSuperstepAdapter:
+    """SuperstepRunner hooks for ComputationGraph (see nn/superstep.py):
+    batches are dicts keyed by input/output name (DataSet or MultiDataSet
+    sources), masks are dicts whose values may be None — None leaves pass
+    through the scan as the same static absence the per-batch step sees."""
+
+    def __init__(self, net: ComputationGraph):
+        self.net = net
+
+    @staticmethod
+    def _shape(a):
+        return None if a is None else tuple(np.shape(a))
+
+    def signature(self, ds):
+        if isinstance(ds, MultiDataSet):
+            seq = lambda xs: (None if xs is None else
+                              tuple(self._shape(a) for a in xs))
+            return (seq(ds.features), seq(ds.labels),
+                    seq(ds.features_masks), seq(ds.labels_masks))
+        return (self._shape(ds.features), self._shape(ds.labels),
+                self._shape(ds.features_mask), self._shape(ds.labels_mask))
+
+    def batch_nbytes(self, ds):
+        from ..datasets.pipeline import batch_nbytes
+        if isinstance(ds, MultiDataSet):
+            arrays = list(ds.features) + list(ds.labels)
+            for ms in (ds.features_masks, ds.labels_masks):
+                if ms is not None:
+                    arrays.extend(ms)
+            return batch_nbytes(arrays)
+        return batch_nbytes((ds.features, ds.labels, ds.features_mask,
+                             ds.labels_mask))
+
+    def stage(self, window):
+        from ..datasets.pipeline import stage_window
+        return stage_window([self.net._to_inputs(ds) for ds in window])
+
+    def dispatch(self, staged, n, step0):
+        net = self.net
+        xs, ys, fms, lms = staged
+        (net.params, net.state, net.updater_state, net._rng,
+         scores) = net._superstep_fn(
+            net.params, net.state, net.updater_state,
+            jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fms, lms)
+        return scores
+
+    def on_window_end(self, window):
+        last = window[-1]
+        feats = (last.features[0] if isinstance(last, MultiDataSet)
+                 else last.features)
+        self.net.last_batch_size = int(np.shape(feats)[0])

@@ -360,6 +360,18 @@ class MultiLayerNetwork:
             "nn/train_step")
 
     @functools.cached_property
+    def _superstep_fn(self):
+        """Device-resident superstep: `lax.scan` of the train step over a
+        [K, batch, ...] window, RNG chain threaded inside so superstep
+        training is bit-identical to the per-batch loop (nn/superstep.py).
+        One XLA compile per (K, batch signature)."""
+        from .superstep import build_superstep
+        return watch_compiles(
+            jax.jit(build_superstep(self.train_step_fn),
+                    donate_argnums=(0, 1, 2)),
+            "nn/superstep")
+
+    @functools.cached_property
     def predict_fn(self):
         """Raw (unjitted) pure inference step — for callers that jit it
         themselves with custom shardings (distributed evaluation plane)."""
@@ -388,7 +400,7 @@ class MultiLayerNetwork:
             out, _, _, new_carries = self._forward(params, state, x, False,
                                                    None, carries=carries)
             return out, new_carries
-        return jax.jit(step)
+        return watch_compiles(jax.jit(step), "nn/rnn_step")
 
     @functools.cached_property
     def _score_fn(self):
@@ -396,16 +408,28 @@ class MultiLayerNetwork:
             s, _ = self._loss_fn(params, state, x, y, None, fmask=fmask,
                                  lmask=lmask, train=False)
             return s
-        return jax.jit(score)
+        return watch_compiles(jax.jit(score), "nn/score")
 
     # ------------------------------------------------------------------
     # Public training API
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, *,
-            prefetch: bool = False, pad_ragged: bool = False,
+            superstep=1, prefetch: bool = False, pad_ragged: bool = False,
             time_buckets=None, checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0, resume: bool = False, guard=None):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels).
+
+        `superstep=K` (iterator inputs) runs the SAME per-batch training
+        through device-resident windows of K batches: one jitted
+        `lax.scan` dispatch per window instead of one per batch, killing
+        the per-batch host-dispatch floor while staying BIT-IDENTICAL to
+        K=1 (see nn/superstep.py). K=1 (default) is the classic per-batch
+        loop; "auto" sizes the window from batch bytes; "epoch" windows
+        the whole epoch (the fit_scan regime). Listeners, `guard` checks
+        and checkpoint/SIGTERM saves fire at superstep edges with the
+        per-window loss vector; ragged tails just close a window early.
+        Falls back to per-batch dispatch (with a log line) for
+        line-search optimizers and TBPTT configs.
 
         Input-pipeline knobs (iterator inputs only; see
         `datasets/pipeline.py`):
@@ -448,6 +472,10 @@ class MultiLayerNetwork:
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress); wrap the "
                     "DataSet in a ListDataSetIterator")
+            if superstep != 1:
+                log.info("superstep=%r ignored for a single-DataSet fit "
+                         "(one batch is one step); pass an iterator to "
+                         "window batches", superstep)
             if guard is not None:
                 guard.run_step(self, lambda: self._fit_batch(data))
             else:
@@ -474,6 +502,13 @@ class MultiLayerNetwork:
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        if runner is not None:
+            runner.skip(skip)
+            skip = 0
+            if self.listeners:
+                from ..optimize.listeners import warn_scan_replay
+                warn_scan_replay(self.listeners)
         sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
                    else _null_span())
         try:
@@ -483,23 +518,26 @@ class MultiLayerNetwork:
                         if hasattr(listener, "on_epoch_start"):
                             listener.on_epoch_start(self)
                     data.reset()
-                    while data.has_next():
-                        ds = (guard.next_batch(data) if guard is not None
-                              else data.next())
-                        if skip:
-                            # resume: this prefix of the epoch already
-                            # trained before the interruption — drawing
-                            # (and discarding) it keeps the iterator
-                            # position identical to the uninterrupted run
-                            skip -= 1
-                            continue
-                        if guard is not None:
-                            guard.run_step(self,
-                                           lambda b=ds: self._fit_batch(b))
-                        else:
-                            self._fit_batch(ds)
-                        if ckpt is not None:
-                            ckpt.on_batch()
+                    if runner is not None:
+                        runner.run_epoch(data)
+                    else:
+                        while data.has_next():
+                            ds = (guard.next_batch(data) if guard is not None
+                                  else data.next())
+                            if skip:
+                                # resume: this prefix of the epoch already
+                                # trained before the interruption — drawing
+                                # (and discarding) it keeps the iterator
+                                # position identical to the uninterrupted run
+                                skip -= 1
+                                continue
+                            if guard is not None:
+                                guard.run_step(self,
+                                               lambda b=ds: self._fit_batch(b))
+                            else:
+                                self._fit_batch(ds)
+                            if ckpt is not None:
+                                ckpt.on_batch()
                     for listener in self.listeners:
                         if hasattr(listener, "on_epoch_end"):
                             listener.on_epoch_end(self)
@@ -512,6 +550,29 @@ class MultiLayerNetwork:
             close()
         return self
 
+    def _make_superstep_runner(self, superstep, guard, ckpt):
+        """SuperstepRunner for this fit, or None for the per-batch loop
+        (superstep=1, line-search optimizers, TBPTT)."""
+        from .conf import OptimizationAlgorithm as OA
+        from .superstep import SuperstepRunner, validate_superstep
+
+        k = validate_superstep(superstep)
+        if k == 1:
+            return None
+        reason = None
+        if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            reason = ("line-search optimizers (CG/LBFGS) are per-batch "
+                      "sequential")
+        elif self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            reason = ("TBPTT chunks each batch on host; use fit_scan for "
+                      "device-resident TBPTT epochs")
+        if reason is not None:
+            log.info("superstep=%r falls back to per-batch dispatch: %s",
+                     superstep, reason)
+            return None
+        return SuperstepRunner(self, _NetworkSuperstepAdapter(self), k,
+                               guard=guard, ckpt=ckpt)
+
     # ------------------------------------------------------------------
     # Device-resident epoch training (one dispatch per epoch)
     # ------------------------------------------------------------------
@@ -519,21 +580,18 @@ class MultiLayerNetwork:
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0, resume: bool = False,
                  guard=None):
-        """Stack the dataset's batches into [T, ...] device arrays and
-        `lax.scan` the train step — ONE device dispatch per epoch instead of
-        one per batch. This matters whenever per-dispatch latency is
-        comparable to per-step compute: small models, or remote-tunnel
-        backends where each call pays RPC latency. All batches must share
-        shapes (use a uniform-batch iterator or drop the ragged tail).
-
-        TBPTT series are scanned over (series, chunk): hidden state flows
+        """Device-resident epoch training — since the superstep refactor a
+        THIN ALIAS for `fit(..., superstep="epoch")`: the whole epoch runs
+        as one jitted `lax.scan` window, bit-identical to the per-batch
+        loop (nn/superstep.py). Kept for API compatibility and for the two
+        cases the unified loop routes specially: TBPTT configs (scanned
+        over (series, chunk) via fit_scan_arrays — hidden state flows
         between a series' chunks and resets at series boundaries; a ragged
-        final chunk is padded to the chunk length under a zero label-mask
-        (exact — padded steps contribute no loss and no gradient).
-        Equivalent math to `fit()` (reference `MultiLayerNetwork.fit`
-        /`doTruncatedBPTT`, MultiLayerNetwork.java:947/:1119), rebatched
-        for the accelerator. Line-search optimizers (CG/LBFGS) are
-        inherently per-batch sequential and fall back to the fit() loop."""
+        final chunk is padded to the chunk length under a zero label-mask,
+        exactly the reference's doTruncatedBPTT semantics) and line-search
+        optimizers (per-batch sequential, delegated to the fit() loop).
+        All batches must share shapes (use pad_ragged=True, a
+        uniform-batch iterator, or drop the ragged tail)."""
         from .conf import OptimizationAlgorithm as OA
 
         if self.params is None:
@@ -572,6 +630,16 @@ class MultiLayerNetwork:
                 "pad the ragged tail (pad_ragged=True — weight-zero rows, "
                 "a learning no-op), drop it (ArrayDataSetIterator("
                 "drop_last=True)), or use fit()")
+        tbptt = (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                 and np.asarray(batches[0].features).ndim >= 3)
+        if not tbptt:
+            # the unified loop: one superstep window per epoch
+            from ..datasets.iterators import ListDataSetIterator
+            return self.fit(ListDataSetIterator(batches), epochs=epochs,
+                            superstep="epoch",
+                            checkpoint_dir=checkpoint_dir,
+                            checkpoint_every=checkpoint_every,
+                            resume=resume, guard=guard)
         xs = np.stack([np.asarray(b.features) for b in batches])
         ys = np.stack([np.asarray(b.labels) for b in batches])
 
@@ -978,7 +1046,7 @@ class MultiLayerNetwork:
             new_pi = {k: params[i][k] - updates[k] for k in params[i]}
             return new_pi, opt_i, score
 
-        return jax.jit(pstep)
+        return watch_compiles(jax.jit(pstep), "nn/pretrain_step")
 
     # ------------------------------------------------------------------
     # Stateful RNN inference (reference rnnTimeStep / rnnClearPreviousState)
@@ -1076,7 +1144,9 @@ class MultiLayerNetwork:
     @functools.cached_property
     def _score_examples_fn(self):
         """add_reg static: at most two compiles (with/without reg terms)."""
-        return jax.jit(self.score_examples_fn, static_argnums=(6,))
+        return watch_compiles(
+            jax.jit(self.score_examples_fn, static_argnums=(6,)),
+            "nn/score_examples")
 
     def score_examples(self, data, add_regularization_terms: bool = True
                        ) -> np.ndarray:
@@ -1134,10 +1204,10 @@ class MultiLayerNetwork:
     @functools.cached_property
     def _recon_logp_fn(self):
         layer0 = self.layers[0]
-        return jax.jit(
+        return watch_compiles(jax.jit(
             lambda p, x, rng, n: layer0.reconstruction_probability(
                 p, x, rng, num_samples=n),
-            static_argnums=(3,))
+            static_argnums=(3,)), "nn/recon_logp")
 
     def reconstruction_probability(self, x, num_samples: int = 5,
                                    seed: int = 0) -> np.ndarray:
@@ -1199,3 +1269,50 @@ class MultiLayerNetwork:
             m._rng = self._rng
         m.iteration_count = self.iteration_count
         return m
+
+
+class _NetworkSuperstepAdapter:
+    """SuperstepRunner hooks for MultiLayerNetwork (see nn/superstep.py):
+    array-shaped batches, masks optional."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        self.net = net
+
+    @staticmethod
+    def _shape(a):
+        return None if a is None else tuple(np.shape(a))
+
+    def signature(self, ds):
+        x = ds.features
+        if not hasattr(x, "ndim"):
+            x = np.asarray(x)
+        self.net._check_input_width(x)
+        return (self._shape(ds.features), self._shape(ds.labels),
+                self._shape(ds.features_mask), self._shape(ds.labels_mask))
+
+    def batch_nbytes(self, ds):
+        from ..datasets.pipeline import batch_nbytes
+        return batch_nbytes((ds.features, ds.labels, ds.features_mask,
+                             ds.labels_mask))
+
+    def stage(self, window):
+        from ..datasets.pipeline import stage_window
+        return stage_window([ds.device_tuple() for ds in window])
+
+    def dispatch(self, staged, n, step0):
+        net = self.net
+        xs, ys, fm, lm = staged
+        (net.params, net.state, net.updater_state, net._rng,
+         scores) = net._superstep_fn(
+            net.params, net.state, net.updater_state,
+            jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fm, lm)
+        return scores
+
+    def on_window_end(self, window):
+        net = self.net
+        last = window[-1]
+        net.last_input = last.device_tuple()[0]
+        net.last_batch_size = int(np.shape(last.features)[0])
+        net._track_signature_shapes(
+            self._shape(last.features), self._shape(last.labels),
+            self._shape(last.features_mask), self._shape(last.labels_mask))

@@ -1,0 +1,363 @@
+"""Device-resident supersteps: one training loop at fit_scan speed.
+
+BENCH_r05 measured the per-batch ``fit()`` path at ~226k samples/s on
+LeNet against ~1.5M for the device-resident ``fit_scan`` path — a ~6.7x
+gap the telemetry dispatch spans attribute entirely to per-batch host
+dispatch. The superstep closes it without forking the API: ``fit(...,
+superstep=K)`` groups the iterator's batches into on-device windows of K
+and runs each window as ONE jitted ``lax.scan`` of the train step, so the
+host pays one dispatch per K batches instead of one per batch.
+
+Per-batch API semantics are preserved:
+
+  * **Bit-exactness.** The scan body threads the model's RNG key through
+    the same ``jax.random.split`` chain the per-batch loop draws
+    host-side, and the step counter increments inside the scan — a
+    ``superstep=K`` fit produces bit-identical params, updater state and
+    RNG to the ``superstep=1`` per-batch fit, for ANY window grouping
+    (windows are a pure regrouping of the identical per-batch math).
+  * **Ragged tails.** Windows never mix batch signatures: a ragged final
+    batch (or a ``time_buckets`` signature change) simply closes the
+    current window and opens a new one. ``pad_ragged=True`` keeps the
+    whole epoch to one signature exactly as on the per-batch path.
+  * **Listeners** replay at the superstep edge with the
+    already-transferred per-window loss vector: every ``iteration_done``
+    sees a HOST scalar in ``model._score``, so score-reading listeners
+    cost no device sync (and per-iteration param histograms see
+    end-of-window params — the same ``warn_scan_replay`` caveat as
+    ``fit_scan``).
+  * **TrainingGuard** checks the window's K losses at the superstep edge
+    (``guard.check_scores``); skip_batch/rollback restore the
+    pre-superstep snapshot, so a poisoned window never escapes.
+  * **Checkpoints / SIGTERM** fire at superstep edges via
+    ``FitCheckpointer.on_batches`` — the first boundary where model state
+    and the recorded batch cursor agree. Resume composes with any K: a
+    checkpoint at a non-window-aligned batch ordinal resumes bit-exactly
+    because window grouping does not change the math.
+
+Overlap: when neither a guard nor a checkpointer needs the model state at
+window boundaries, the loop runs PIPELINED — the next window is drawn,
+stacked and transferred (``datasets/pipeline.py`` staging) while the
+current superstep computes on device, and the loss sync for window i
+happens after window i+1 has been dispatched. The device never waits on
+host batch assembly.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..telemetry.runtime import active as _tel_active, null_span as _null_span
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["AUTO_WINDOW_BYTES", "AUTO_MAX_K", "EPOCH", "auto_superstep_k",
+           "validate_superstep", "build_superstep", "SuperstepRunner"]
+
+#: ``superstep="auto"`` sizes the window so its stacked device footprint
+#: stays near this budget — big enough to amortize dispatch, small enough
+#: that window staging never competes with model state for memory.
+AUTO_WINDOW_BYTES = 64 << 20
+AUTO_MAX_K = 32
+#: ``superstep="epoch"``: the window is bounded only by the epoch (and by
+#: signature changes) — the fit_scan regime expressed through fit().
+EPOCH = "epoch"
+
+
+def auto_superstep_k(batch_bytes: int,
+                     target_bytes: int = AUTO_WINDOW_BYTES,
+                     max_k: int = AUTO_MAX_K) -> int:
+    """Window length for ``superstep="auto"``: as many batches as fit the
+    byte budget, clamped to [1, max_k]."""
+    if batch_bytes <= 0:
+        return int(max_k)
+    return max(1, min(int(max_k), int(target_bytes // batch_bytes)))
+
+
+def validate_superstep(superstep):
+    """Normalize the ``superstep=`` knob: a positive int, "auto", or
+    "epoch". Returns the normalized value (ints coerced)."""
+    if superstep in ("auto", EPOCH):
+        return superstep
+    try:
+        k = int(superstep)
+    except (TypeError, ValueError):
+        k = 0
+    if k < 1 or (not isinstance(superstep, (int, np.integer))):
+        raise ValueError(
+            f"superstep={superstep!r} — expected a positive int (window "
+            "length in batches; 1 = per-batch dispatch), 'auto' (size the "
+            "window from batch bytes) or 'epoch' (one window per epoch)")
+    return k
+
+
+def build_superstep(step_fn):
+    """The raw (unjitted) superstep: ``lax.scan`` of ``step_fn`` over a
+    [K, batch, ...] window of stacked inputs.
+
+    ``step_fn`` is a model's pure train step ``(params, state, opt, step,
+    x, y, rng, fmask, lmask) -> (params, state, opt, score)`` — arrays for
+    MultiLayerNetwork, dicts for ComputationGraph, and the ZeRO step from
+    ``parallel/zero.py`` all share this signature, so one builder serves
+    every family. Mask slots may be None pytrees; a None leaf passes
+    through the scan untouched, so the body sees the same static absence
+    the per-batch step does.
+
+    The RNG is split INSIDE the scan with the exact chain the per-batch
+    loop draws host-side (``rng, k = split(rng)`` per step), making
+    superstep-K training bit-identical to K=1 — and keeping the split on
+    device instead of paying 2K tiny host dispatches per window."""
+    import jax
+
+    def superstep(params, state, opt_state, step0, rng0, xs, ys, fm, lm):
+        def body(carry, inp):
+            params, state, opt, step, rng = carry
+            x, y, f, l = inp
+            rng, k = jax.random.split(rng)
+            params, state, opt, score = step_fn(params, state, opt, step,
+                                                x, y, k, f, l)
+            return (params, state, opt, step + 1, rng), score
+
+        (params, state, opt, _step, rng), scores = jax.lax.scan(
+            body, (params, state, opt_state, step0, rng0), (xs, ys, fm, lm))
+        return params, state, opt, rng, scores
+
+    return superstep
+
+
+class SuperstepRunner:
+    """The windowed inner fit loop, shared by MultiLayerNetwork.fit,
+    ComputationGraph.fit and ParallelTrainer.fit.
+
+    The model-specific pieces live in an *adapter* with five hooks:
+
+      signature(ds)    hashable batch signature (windows never mix
+                       signatures), or None to consume the batch without
+                       training it (e.g. a batch that trims to zero rows
+                       on the mesh)
+      batch_nbytes(ds) bytes of one batch (``superstep="auto"`` sizing)
+      stage(window)    stack the window's batches into [K, batch, ...]
+                       device pytrees (datasets/pipeline.py staging)
+      dispatch(staged, n, step0)
+                       run the jitted superstep, rebinding the model's
+                       params/state/updater/RNG in place; returns the
+                       device [K] loss vector WITHOUT syncing it
+      on_window_end(window)
+                       per-window bookkeeping (last_input/last_batch_size,
+                       signature tracking, telemetry samples) — runs only
+                       for KEPT windows, before the listener replay
+
+    One runner drives one fit() call; `skip()` positions the resume
+    cursor before the first epoch.
+    """
+
+    def __init__(self, model, adapter, superstep, *, guard=None, ckpt=None):
+        self.model = model
+        self.adapter = adapter
+        self.superstep = validate_superstep(superstep)
+        self.guard = guard
+        self.ckpt = ckpt
+        self._k: Optional[int] = (self.superstep
+                                  if isinstance(self.superstep, int) else None)
+        self._skip = 0
+        self._pending = None   # drawn batch belonging to the next window
+        self._untrained = 0    # consumed untrainable batches awaiting a
+                               # window-edge cursor advance
+        self._staged_memo = None   # single-slot (ids, staged, window refs)
+        # Pipelining (stage window i+1 while window i computes, sync i's
+        # losses after i+1 dispatched) is only safe when nothing host-side
+        # consumes model state at window boundaries: a guard may roll the
+        # window back, a checkpointer may save mid-loop — both need the
+        # boundary finalized before the next dispatch.
+        self._pipelined = guard is None and ckpt is None
+
+    def skip(self, n: int):
+        """Resume bookkeeping: draw and discard the first `n` batches (the
+        prefix the interrupted run already trained) before windowing."""
+        self._skip = max(0, int(n))
+
+    # ------------------------------------------------------------------
+    def _resolve_k(self, ds):
+        if self._k is not None:
+            return
+        if self.superstep == "auto":
+            self._k = auto_superstep_k(self.adapter.batch_nbytes(ds))
+            log.info("superstep='auto' resolved to K=%d (batch ~%.2f MB, "
+                     "window budget %d MB)", self._k,
+                     self.adapter.batch_nbytes(ds) / 1e6,
+                     AUTO_WINDOW_BYTES >> 20)
+        else:   # EPOCH: bounded only by the epoch / signature changes
+            self._k = 1 << 30
+
+    def _collect(self, data):
+        """Next window: up to K consecutive batches sharing one signature.
+        A signature change (ragged tail, time-bucket switch) closes the
+        window; the odd batch opens the next one."""
+        guard = self.guard
+        window, sig0 = [], None
+        while True:
+            if self._pending is not None:
+                ds, self._pending = self._pending, None
+            elif data.has_next():
+                ds = (guard.next_batch(data) if guard is not None
+                      else data.next())
+            else:
+                break
+            if self._skip:
+                self._skip -= 1
+                continue
+            sig = self.adapter.signature(ds)
+            if sig is None:
+                # consumed but untrainable (per-batch path does the same).
+                # The batch cursor advances only at the NEXT window edge /
+                # epoch end (_finalize folds this count in): advancing it
+                # here, while earlier window batches are drawn but not yet
+                # trained, would let a deferred-SIGTERM snapshot record a
+                # cursor ahead of the trained state and lose a batch on
+                # resume
+                self._untrained += 1
+                continue
+            if sig0 is None:
+                self._resolve_k(ds)
+                sig0 = sig
+            elif sig != sig0:
+                self._pending = ds
+                break
+            window.append(ds)
+            if len(window) >= self._k:
+                break
+        return window
+
+    def _stage(self, window):
+        """Stage a window, with a SINGLE-SLOT identity memo: the
+        whole-epoch window regime (the fit_scan alias) re-presents the
+        exact same batch objects every epoch, and re-staging them would
+        re-pay a full-dataset device stack per epoch that the historic
+        fit_scan staged once. The staged arrays are never donated by the
+        superstep jit, so cross-epoch reuse is safe. K-window regimes and
+        streaming iterators churn the one slot harmlessly (no growth, no
+        stale hits — the key is the tuple of batch object identities,
+        kept alive by the stored window refs)."""
+        if not window:
+            return None
+        key = tuple(id(ds) for ds in window)
+        memo = self._staged_memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        staged = self.adapter.stage(window)
+        self._staged_memo = (key, staged, window)
+        return staged
+
+    # ------------------------------------------------------------------
+    def run_epoch(self, data):
+        tel = _tel_active()
+        span = tel.span if tel is not None else _null_span
+        if self._pipelined:
+            self._run_pipelined(data, span)
+        else:
+            self._run_sequential(data, span)
+        if self._untrained and self.ckpt is not None:
+            # untrainable tail batches with no following window: flush the
+            # cursor at the epoch edge (model state is final here, so the
+            # cursor and trained state agree)
+            self.ckpt.on_batches(self._untrained)
+            self._untrained = 0
+
+    def _run_sequential(self, data, span):
+        """Guard/checkpoint mode: each window is finalized (losses synced,
+        guard verdict applied, checkpoint cursor advanced) before the next
+        window is dispatched — a rollback can never race a dispatch."""
+        while True:
+            with span("host/batch_prep", kind="superstep_window"):
+                window = self._collect(data)
+                staged = self._stage(window)
+            if not window:
+                return
+            snap = self._pre_window_snapshot()
+            with span("device/dispatch", kind="superstep"):
+                scores = self.adapter.dispatch(staged, len(window),
+                                               self.model.iteration_count)
+            self._finalize(window, scores, snap, span)
+
+    def _run_pipelined(self, data, span):
+        """No guard, no checkpointer: window i+1 is collected, stacked and
+        transferred while window i computes on device. With no listeners
+        attached, window i's finalize (loss sync) is additionally DEFERRED
+        until window i+1 has been dispatched — the sync lands on a window
+        that already finished while its successor was being staged, so the
+        device never idles at a window boundary and the host never blocks
+        on an in-flight computation (except the last window; the
+        one-window lag also bounds staging memory to two windows). With
+        listeners, finalize runs BEFORE the next dispatch so every replay
+        observes exactly the end-of-its-own-window params — the documented
+        warn_scan_replay contract, never a window ahead."""
+        lag = not (getattr(self.model, "listeners", None) or [])
+        step0 = self.model.iteration_count
+        inflight = None   # (window, scores_dev) — one window of lag
+        with span("host/batch_prep", kind="superstep_window"):
+            window = self._collect(data)
+            staged = self._stage(window)
+        while window:
+            with span("device/dispatch", kind="superstep"):
+                scores = self.adapter.dispatch(staged, len(window), step0)
+            step0 += len(window)
+            cur = (window, scores)
+            with span("host/batch_prep", kind="superstep_window"):
+                window = self._collect(data)
+                staged = self._stage(window)
+            if lag:
+                if inflight is not None:
+                    self._finalize(inflight[0], inflight[1], None, span)
+                inflight = cur
+            else:
+                self._finalize(cur[0], cur[1], None, span)
+        if inflight is not None:
+            self._finalize(inflight[0], inflight[1], None, span)
+
+    # ------------------------------------------------------------------
+    def _pre_window_snapshot(self):
+        g = self.guard
+        if g is None or not g._needs_snapshot:
+            return None
+        # device-side copy BEFORE dispatch: the superstep donates the
+        # model trees, so post-dispatch the originals are invalidated
+        return g._snapshot(self.model)
+
+    def _finalize(self, window, scores_dev, snap, span):
+        model = self.model
+        n = len(window)
+        with span("device/sync", kind="superstep_scores"):
+            host_scores = np.asarray(scores_dev)
+        kept = True
+        if self.guard is not None:
+            # superstep-granular guard: a bad window is discarded WHOLE,
+            # restoring the pre-superstep snapshot (params/updater/RNG/
+            # counters) — fit_scan's epoch-granular contract at window
+            # granularity
+            kept = self.guard.check_scores(model, host_scores, snap)
+        if kept:
+            self.adapter.on_window_end(window)
+            listeners = getattr(model, "listeners", None) or []
+            if listeners:
+                # replay at the superstep edge with the ALREADY-TRANSFERRED
+                # loss vector: every iteration_done sees a HOST scalar, so
+                # listeners reading model.score() re-sync nothing
+                # (graftlint hot-loop-sync stays structurally quiet here)
+                for i in range(n):
+                    model._score = host_scores[i]
+                    model.iteration_count += 1
+                    for listener in listeners:
+                        listener.iteration_done(model, model.iteration_count)
+            else:
+                model._score = host_scores[-1]
+                model.iteration_count += n
+        if self.ckpt is not None:
+            # cursor advances for kept AND discarded windows (the batches
+            # were consumed either way — per-batch fit does the same),
+            # plus any untrainable batches consumed during collection —
+            # counted HERE, at the edge, so the cursor never runs ahead
+            # of the trained state
+            self.ckpt.on_batches(n + self._untrained)
+            self._untrained = 0

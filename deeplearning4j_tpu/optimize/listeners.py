@@ -69,7 +69,14 @@ class ScoreIterationListener(IterationListener):
 
 class PerformanceListener(IterationListener):
     """Samples/sec + batches/sec reporting (`optimize/listeners/PerformanceListener.java`).
-    This is the metric surfaced by bench.py."""
+    This is the metric surfaced by bench.py.
+
+    Superstep/scan fits replay this hook at the window edge with the
+    already-transferred per-window loss vector (model._score holds a HOST
+    scalar per replayed iteration), so `report_score=True` reads the
+    window vector instead of forcing a device sync per reported iteration;
+    only the per-batch (superstep=1) path pays a sync, and only when the
+    report fires."""
 
     def __init__(self, frequency: int = 1, report_score: bool = False,
                  printer: Optional[Callable] = None):

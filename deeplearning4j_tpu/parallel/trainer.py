@@ -231,9 +231,13 @@ class ParallelTrainer:
                 # CPU backend lacks; place replicated, then let an SPMD
                 # identity slice each process's shards out
                 opt = jax.device_put(m.updater_state, repl)
-                self._opt = jax.jit(lambda t: t, out_shardings=o_sh)(opt)
+                self._opt = watch_compiles(
+                    jax.jit(lambda t: t, out_shardings=o_sh),
+                    "parallel/opt_placement")(opt)
             else:
                 self._opt = jax.device_put(m.updater_state, o_sh)
+            self._raw_step_fn = step_fn
+            self._o_sh = o_sh
             self._step_fn = watch_compiles(jax.jit(
                 step_fn,
                 in_shardings=(repl, repl, o_sh, repl, batch_sh, batch_sh,
@@ -252,6 +256,8 @@ class ParallelTrainer:
             self._params = jax.device_put(m.params, p_sh)
             self._state = jax.device_put(m.state, repl)
             self._opt = jax.device_put(m.updater_state, o_sh)
+            self._raw_step_fn = m.train_step_fn
+            self._o_sh = o_sh
             self._step_fn = watch_compiles(jax.jit(
                 m.train_step_fn,
                 in_shardings=(p_sh, repl, o_sh, repl, batch_sh, batch_sh,
@@ -259,6 +265,10 @@ class ParallelTrainer:
                 out_shardings=(p_sh, repl, o_sh, repl),
                 donate_argnums=(0, 1, 2)), "parallel/train_step")
         else:
+            # AVERAGING: no superstep (per-replica local SGD averages on a
+            # host-driven cadence) — per-batch dispatch only
+            self._raw_step_fn = None
+            self._o_sh = None
             # AVERAGING: per-device replicas — stack params on a leading
             # device axis sharded over data
             n = self.n_data
@@ -325,11 +335,15 @@ class ParallelTrainer:
         # a possibly-identical iteration count
         self._host_cache = None
         self._eval_cache = None
+        # a restore re-prepares with a fresh raw step closure; drop the
+        # cached superstep jit so it can't capture the stale one
+        self.__dict__.pop("_superstep_jit", None)
         self._rng = m._rng if getattr(m, "_rng", None) is not None else \
             jax.random.PRNGKey(0)
 
     # ------------------------------------------------------------------
-    def fit(self, data, epochs: int = 1, *, prefetch: bool = False,
+    def fit(self, data, epochs: int = 1, *, superstep=1,
+            prefetch: bool = False,
             pad_ragged: bool = False, time_buckets=None,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
             resume: bool = False, guard=None):
@@ -340,6 +354,17 @@ class ParallelTrainer:
         sharded step keeps ONE signature. `prefetch` stages
         `device_tuple()` one batch ahead on a background thread (see
         datasets/pipeline.py).
+
+        `superstep=K` composes the device-resident superstep (one jitted
+        `lax.scan` dispatch per K-batch window — nn/superstep.py) with the
+        SYNC sharded step: REPLICATED, TENSOR_PARALLEL, FSDP and the ZeRO
+        strategies all scan their own step with the training shardings
+        carried through the window. REPLICATED windows are BIT-IDENTICAL
+        to per-batch; the ZeRO strategies are allclose-tight (~float32
+        ulp) — XLA may reassociate the step's collectives inside the scan
+        body. Falls back to per-batch dispatch (with a log line) for
+        AVERAGING/PIPELINE, multi-process meshes, and `collect_stats`
+        (whose phase timers are per-batch by contract).
 
         Fault-tolerance knobs mirror `MultiLayerNetwork.fit`, backed by
         the **sharded** store (`parallel/checkpoint.py`): step dirs with
@@ -365,6 +390,12 @@ class ParallelTrainer:
                 raise ValueError(
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress)")
+            if superstep != 1:
+                import logging
+                logging.getLogger("deeplearning4j_tpu").info(
+                    "superstep=%r ignored for a single-DataSet fit (one "
+                    "batch is one step); pass an iterator to window "
+                    "batches", superstep)
             if guard is not None:
                 guard.run_step(self, lambda: self._fit_batch(data))
             else:
@@ -379,25 +410,32 @@ class ParallelTrainer:
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
+        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        if runner is not None:
+            runner.skip(skip)
+            skip = 0
         sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
                    else _null_span())
         try:
             with sigterm:
                 for _ in range(max(0, epochs - done_epochs)):
                     data.reset()
-                    while data.has_next():
-                        ds = (guard.next_batch(data) if guard is not None
-                              else data.next())
-                        if skip:
-                            skip -= 1   # resume: prefix already trained
-                            continue
-                        if guard is not None:
-                            guard.run_step(self,
-                                           lambda b=ds: self._fit_batch(b))
-                        else:
-                            self._fit_batch(ds)
-                        if ckpt is not None:
-                            ckpt.on_batch()
+                    if runner is not None:
+                        runner.run_epoch(data)
+                    else:
+                        while data.has_next():
+                            ds = (guard.next_batch(data) if guard is not None
+                                  else data.next())
+                            if skip:
+                                skip -= 1   # resume: prefix already trained
+                                continue
+                            if guard is not None:
+                                guard.run_step(self,
+                                               lambda b=ds: self._fit_batch(b))
+                            else:
+                                self._fit_batch(ds)
+                            if ckpt is not None:
+                                ckpt.on_batch()
                     if ckpt is not None:
                         ckpt.on_epoch()
                 if ckpt is not None:
@@ -406,6 +444,51 @@ class ParallelTrainer:
             close()
         self._sync_back()
         return self
+
+    def _make_superstep_runner(self, superstep, guard, ckpt):
+        """SuperstepRunner composing the window scan with the sharded SYNC
+        step, or None for per-batch dispatch (superstep=1, AVERAGING,
+        PIPELINE, multi-process, collect_stats)."""
+        from ..nn.superstep import SuperstepRunner, validate_superstep
+
+        k = validate_superstep(superstep)
+        if k == 1:
+            return None
+        reason = None
+        if getattr(self, "_raw_step_fn", None) is None:
+            reason = (f"mode={self.mode}/strategy={self.strategy} trains "
+                      "per batch (host-driven averaging/pipeline schedule)")
+        elif jax.process_count() > 1:
+            reason = ("multi-process meshes assemble the global batch per "
+                      "step on host")
+        elif self.stats is not None:
+            reason = "collect_stats times phases per batch by contract"
+        if reason is not None:
+            import logging
+            logging.getLogger("deeplearning4j_tpu").info(
+                "superstep=%r falls back to per-batch dispatch: %s",
+                superstep, reason)
+            return None
+        return SuperstepRunner(self, _TrainerSuperstepAdapter(self), k,
+                               guard=guard, ckpt=ckpt)
+
+    @functools.cached_property
+    def _superstep_jit(self):
+        """Jitted superstep for the SYNC strategies: `lax.scan` of the raw
+        (ZeRO or plain) train step over a [K, batch, ...] window, with the
+        training shardings carried through — the window's batch axis 1 is
+        sharded over `data`, params/opt keep their strategy shardings, and
+        buffers donate end-to-end like the per-batch step."""
+        from ..nn.superstep import build_superstep
+
+        win = NamedSharding(self.mesh, P(None, self.data_axis))
+        repl = self._repl
+        return watch_compiles(jax.jit(
+            build_superstep(self._raw_step_fn),
+            in_shardings=(self._p_sh, repl, self._o_sh, repl, repl,
+                          win, win, win, win),
+            out_shardings=(self._p_sh, repl, self._o_sh, repl, repl),
+            donate_argnums=(0, 1, 2)), "parallel/superstep")
 
     def _to_batch(self, ds):
         """(inputs, labels, fmasks, lmasks) pytrees: arrays for
@@ -630,14 +713,15 @@ class ParallelTrainer:
 
     @functools.cached_property
     def _score_raw(self):
-        return jax.jit(self._score_fn_raw)
+        return watch_compiles(jax.jit(self._score_fn_raw), "parallel/score")
 
     @functools.cached_property
     def _eval_score(self):
         b = self._batch_sh
-        return jax.jit(self._score_fn_raw,
-                       in_shardings=(self._p_sh, self._repl, b, b, b, b),
-                       out_shardings=self._repl)
+        return watch_compiles(
+            jax.jit(self._score_fn_raw,
+                    in_shardings=(self._p_sh, self._repl, b, b, b, b),
+                    out_shardings=self._repl), "parallel/eval_score")
 
     # ------------------------------------------------------------------
     # Distributed evaluation / scoring plane.
@@ -696,17 +780,20 @@ class ParallelTrainer:
 
     @functools.cached_property
     def _eval_predict(self):
-        return jax.jit(self.model.predict_fn,
-                       in_shardings=(self._p_sh, self._repl, self._batch_sh,
-                                     self._batch_sh),
-                       out_shardings=self._repl)
+        return watch_compiles(
+            jax.jit(self.model.predict_fn,
+                    in_shardings=(self._p_sh, self._repl, self._batch_sh,
+                                  self._batch_sh),
+                    out_shardings=self._repl), "parallel/eval_predict")
 
     @functools.cached_property
     def _eval_score_examples(self):
         b = self._batch_sh
-        return jax.jit(self.model.score_examples_fn,
-                       in_shardings=(self._p_sh, self._repl, b, b, b, b),
-                       out_shardings=self._repl, static_argnums=(6,))
+        return watch_compiles(
+            jax.jit(self.model.score_examples_fn,
+                    in_shardings=(self._p_sh, self._repl, b, b, b, b),
+                    out_shardings=self._repl, static_argnums=(6,)),
+            "parallel/eval_score_examples")
 
     def _pad_to(self, tree, n_div):
         """Zero-pad the batch axis to a multiple of the data axis so SPMD
@@ -910,11 +997,12 @@ class ParallelTrainer:
         layer0 = self.model.layers[0]
         p_sh0 = (self._p_sh[0] if isinstance(self._p_sh, (tuple, list))
                  else self._p_sh)
-        return jax.jit(
+        return watch_compiles(jax.jit(
             lambda p, x, rng, n: layer0.reconstruction_probability(
                 p, x, rng, num_samples=n),
             in_shardings=(p_sh0, self._batch_sh, self._repl),
-            out_shardings=self._repl, static_argnums=(3,))
+            out_shardings=self._repl, static_argnums=(3,)),
+            "parallel/eval_recon_logp")
 
     # -- multi-process map side: host-local compute on the local shard -----
     def _local_params_state(self):
@@ -972,6 +1060,93 @@ class ParallelTrainer:
             self.model.state = take(self._state)
             self.model.updater_state = take(self._opt)
         self.model.iteration_count = self.iteration_count
+
+
+class _TrainerSuperstepAdapter:
+    """SuperstepRunner hooks for ParallelTrainer (see nn/superstep.py):
+    batches route through `_to_batch` (arrays for MultiLayerNetwork, dicts
+    for ComputationGraph) and are trimmed to the data-axis multiple
+    exactly as the per-batch step trims them; a batch that trims to zero
+    rows is consumed untrained (signature None), matching per-batch."""
+
+    def __init__(self, trainer: ParallelTrainer):
+        self.trainer = trainer
+        self._memo = {}   # id(ds) -> trimmed batch (signature -> stage)
+
+    def _trimmed(self, ds):
+        key = id(ds)
+        if key in self._memo:
+            return self._memo[key]
+        tr = self.trainer
+        tmap = jax.tree_util.tree_map
+        xd, yd, fm, lm = tr._to_batch(ds)
+        bs = jax.tree_util.tree_leaves(xd)[0].shape[0]
+        keep = (bs // tr.n_data) * tr.n_data
+        if keep == 0:
+            return None
+        if keep != bs:
+            trim = lambda t: tmap(lambda a: a[:keep], t)
+            xd, yd, fm, lm = trim(xd), trim(yd), trim(fm), trim(lm)
+        self._memo[key] = (xd, yd, fm, lm)
+        return self._memo[key]
+
+    def _take(self, ds):
+        return self._memo.pop(id(ds), None) or self._trimmed(ds)
+
+    def signature(self, ds):
+        batch = self._trimmed(ds)
+        if batch is None:
+            return None
+        shape = lambda t: tuple(
+            (tuple(p), tuple(a.shape))
+            for p, a in jax.tree_util.tree_flatten_with_path(t)[0])
+        return tuple(shape(t) for t in batch)
+
+    def batch_nbytes(self, ds):
+        from ..datasets.pipeline import batch_nbytes
+        batch = self._trimmed(ds)
+        if batch is None:
+            return 0
+        return batch_nbytes(jax.tree_util.tree_leaves(batch))
+
+    def stage(self, window):
+        from ..datasets.pipeline import stage_window
+        return stage_window([self._take(ds) for ds in window])
+
+    def dispatch(self, staged, n, step0):
+        tr = self.trainer
+        xs, ys, fms, lms = staged
+        (tr._params, tr._state, tr._opt, tr._rng,
+         scores) = tr._superstep_jit(
+            tr._params, tr._state, tr._opt,
+            jnp.asarray(step0, jnp.int32), tr._rng, xs, ys, fms, lms)
+        return scores
+
+    def on_window_end(self, window):
+        tr = self.trainer
+        n = len(window)
+        tel = _tel_active()
+        if tel is None:
+            return
+        if tr._zero_info is not None:
+            # static per-step accounting scales linearly over the window
+            cached = getattr(tr, "_zero_metrics", None)
+            if cached is None or cached[0] is not tel:
+                tr._record_zero_metrics(tel)   # creates + counts 1 step
+                remaining = n - 1
+            else:
+                remaining = n
+            if remaining:
+                _, c_bytes, c_flush = tr._zero_metrics
+                info = tr._zero_info
+                for op, b in info["bytes"].items():
+                    if b:
+                        c_bytes.inc(b * remaining, op=op)
+                if info["n_buckets"]:
+                    c_flush.inc(info["n_buckets"] * remaining)
+        w = tel.report_window
+        if (tr.iteration_count + n) // w > tr.iteration_count // w:
+            tel.watermarks.sample(devices=list(tr.mesh.devices.flat))
 
 
 # DL4J-familiar alias

@@ -56,10 +56,14 @@ class TelemetryListener(TrainingListener):
             self._recompiles.set(int(rc))
         if iteration % self.report_window == 0:
             # the score gauge is read HERE, on the report window, not per
-            # step: model.score() materializes the step's device score
-            # (float() -> device->host sync), and doing that every
-            # iteration re-serializes the async dispatch pipeline the
-            # whole fit path is built around (graftlint: hot-loop-sync)
+            # step: on the PER-BATCH path model.score() materializes the
+            # step's device score (float() -> device->host sync), and
+            # doing that every iteration re-serializes the async dispatch
+            # pipeline the whole fit path is built around (graftlint:
+            # hot-loop-sync). On the superstep/scan replay paths the fit
+            # loop has already transferred the per-window loss vector and
+            # hands this hook HOST scalars in model._score, so the read
+            # consumes the window vector and costs no sync at all.
             try:
                 self._score.set(float(model.score()))
             except (TypeError, ValueError):

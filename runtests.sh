@@ -19,6 +19,12 @@
 #                            8-device virtual mesh plus one scaling_bench
 #                            rep with the paired replicated-vs-ZeRO
 #                            ablation (prints the efficiency JSON line)
+#   ./runtests.sh superstep  superstep smoke: the fit(superstep=K)-vs-
+#                            per-batch bit-exact equivalence suite
+#                            (both model families + ParallelTrainer,
+#                            guard rollback, non-aligned resume) plus one
+#                            paired bench rep printing the superstep-vs-
+#                            perbatch speedup + dispatch-span share
 #   ./runtests.sh lint       graftlint static pass (jit/tracer hygiene,
 #                            recompile hazards, donation safety,
 #                            concurrency lint) against the checked-in
@@ -46,6 +52,15 @@ if [[ "${1:-}" == "zero" ]]; then
         XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
         --model mlp --global-batch 64 --steps 2 --reps 1 --no-ablation
+fi
+if [[ "${1:-}" == "superstep" ]]; then
+    echo "=== superstep equivalence smoke ==="
+    python -m pytest tests/test_superstep.py -q
+    echo "=== paired superstep-vs-perbatch bench rep (LeNet) ==="
+    exec python -c 'import json
+from deeplearning4j_tpu.models.zoo import bench_lenet_superstep
+print(json.dumps(bench_lenet_superstep(batch=128, n_batches=8, epochs=2),
+                 indent=1))'
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

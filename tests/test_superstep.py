@@ -1,0 +1,430 @@
+"""Device-resident supersteps (ISSUE 11): fit(superstep=K) == per-batch.
+
+The superstep is a pure regrouping of the per-batch math — the scan body
+threads the SAME RNG split chain and step counter the per-batch loop uses
+— so equivalence is asserted as bit-exact parameter equality, not a
+tolerance, for both model families and for any window grouping (ragged
+tails, resume at non-window-aligned ordinals). Guard and checkpoint
+semantics are asserted at superstep granularity.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.fault.guard import GuardPolicy, TrainingGuard
+from deeplearning4j_tpu.fault.injection import FaultyIterator
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.superstep import (auto_superstep_k,
+                                             validate_superstep)
+
+
+def _mlp(seed=7, dropout=0.0):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_out=32, activation="relu",
+                              dropout=dropout or None))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=7):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(1e-3))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(12)))
+    b.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+    b.add_layer("out", OutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"), "d")
+    b.set_outputs("out")
+    return ComputationGraph(b.build()).init()
+
+
+def _data(n, f=12, c=5, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[r.integers(0, c, n)]
+    return x, y
+
+
+def _it(x, y, batch=16):
+    return ArrayDataSetIterator(x, y, batch_size=batch)
+
+
+def _assert_bit_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for p, q in zip(fa, fb):
+        assert (np.asarray(p) == np.asarray(q)).all()
+
+
+# ---------------------------------------------------------------------------
+# bit-exact equivalence, both model families
+# ---------------------------------------------------------------------------
+def test_superstep_bitexact_vs_perbatch_mlp():
+    """K=3 windows over 7 batches + a ragged 9-row tail (its own window):
+    params, updater state, RNG and counters all bit-equal to K=1."""
+    x, y = _data(7 * 16 + 9)
+    a = _mlp(dropout=0.5)
+    a.fit(_it(x, y), epochs=2)
+    b = _mlp(dropout=0.5)
+    b.fit(_it(x, y), epochs=2, superstep=3)
+    _assert_bit_equal(a.params, b.params)
+    _assert_bit_equal(a.updater_state, b.updater_state)
+    assert (np.asarray(a._rng) == np.asarray(b._rng)).all()
+    assert a.iteration_count == b.iteration_count == 16
+    assert a.epoch_count == b.epoch_count == 2
+
+
+def test_superstep_bitexact_vs_perbatch_graph():
+    x, y = _data(6 * 8)
+    a = _graph()
+    a.fit(_it(x, y, batch=8), epochs=2)
+    b = _graph()
+    b.fit(_it(x, y, batch=8), epochs=2, superstep=4)
+    _assert_bit_equal(a.params, b.params)
+    _assert_bit_equal(a.updater_state, b.updater_state)
+    assert (np.asarray(a._rng) == np.asarray(b._rng)).all()
+    assert a.iteration_count == b.iteration_count
+
+
+def test_superstep_epoch_and_auto_knobs():
+    x, y = _data(5 * 16)
+    a = _mlp()
+    a.fit(_it(x, y), epochs=1)
+    for knob in ("epoch", "auto", 1 << 10):
+        m = _mlp()
+        m.fit(_it(x, y), epochs=1, superstep=knob)
+        _assert_bit_equal(a.params, m.params)
+    # auto sizing: byte budget divided by batch bytes, clamped
+    assert auto_superstep_k(1) >= 1
+    assert auto_superstep_k(1 << 40) == 1
+    assert validate_superstep(4) == 4
+    with pytest.raises(ValueError, match="superstep"):
+        validate_superstep(0)
+    with pytest.raises(ValueError, match="superstep"):
+        validate_superstep("sometimes")
+
+
+def test_fit_scan_is_superstep_alias_bitexact():
+    """fit_scan == fit(superstep='epoch') == per-batch fit, all bit-equal
+    (the historic fit-vs-fit_scan fork is gone)."""
+    x, y = _data(4 * 16)
+    a = _mlp()
+    a.fit(_it(x, y), epochs=2)
+    b = _mlp()
+    b.fit_scan(list(_it(x, y)), epochs=2)
+    _assert_bit_equal(a.params, b.params)
+    assert a.iteration_count == b.iteration_count
+
+
+def test_superstep_compile_counts():
+    """pad_ragged keeps the epoch to one batch signature, so the fit
+    costs one nn/superstep compile per WINDOW LENGTH (the full-K windows
+    plus at most one shorter tail window) and zero per-batch train_step
+    compiles; superstep='epoch' costs exactly one."""
+    from deeplearning4j_tpu.telemetry import runtime as telemetry_runtime
+    from deeplearning4j_tpu.telemetry.runtime import TelemetrySession
+
+    x, y = _data(4 * 16 + 7)
+    m = _mlp()
+    sess = TelemetrySession()
+    with telemetry_runtime.enabled(sess):
+        # 5 padded batches -> windows of [2, 2, 1]: two scan lengths
+        m.fit(_it(x, y), epochs=2, superstep=2, pad_ragged=True)
+    assert sess.compiles.count("nn/superstep") == 2
+    assert sess.compiles.count("nn/train_step") == 0
+
+    m2 = _mlp()
+    sess2 = TelemetrySession()
+    with telemetry_runtime.enabled(sess2):
+        m2.fit(_it(x, y), epochs=2, superstep="epoch", pad_ragged=True)
+    assert sess2.compiles.count("nn/superstep") == 1
+    assert sess2.compiles.count("nn/train_step") == 0
+
+
+def test_superstep_listeners_consume_host_window_scores():
+    """Listener replay at superstep edges hands every iteration_done a
+    HOST scalar from the transferred per-window loss vector — no device
+    re-sync per reported iteration (ISSUE 11 satellite)."""
+    from deeplearning4j_tpu.optimize.listeners import (
+        IterationListener, PerformanceListener)
+
+    seen = []
+
+    class Probe(IterationListener):
+        def iteration_done(self, model, iteration):
+            seen.append((iteration, model._score,
+                         isinstance(model._score, (float, np.floating))))
+
+    x, y = _data(6 * 16)
+    m = _mlp()
+    perf = PerformanceListener(frequency=2, report_score=True,
+                               printer=lambda s: None)
+    m.set_listeners(Probe(), perf)
+    m.fit(_it(x, y), epochs=1, superstep=3)
+    assert len(seen) == 6
+    assert all(host for _, _, host in seen), "device score leaked into replay"
+    assert all(np.isfinite(s) for _, s, _ in seen)
+    assert [i for i, _, _ in seen] == list(range(1, 7))
+    assert len(perf.history) == 3
+    assert all(np.isfinite(r["score"]) for r in perf.history)
+
+
+# ---------------------------------------------------------------------------
+# guard at superstep granularity
+# ---------------------------------------------------------------------------
+def test_superstep_guard_rollback_lands_on_presuperstep_snapshot():
+    """NaN injected inside window 2 discards the WHOLE window: params land
+    bit-exactly on the pre-superstep snapshot (= the model after window 1
+    only)."""
+    x, y = _data(8 * 16)
+    ref = _mlp()
+    ref.fit(ArrayDataSetIterator(x[:4 * 16], y[:4 * 16], batch_size=16),
+            epochs=1, superstep=4)   # window 1 only
+
+    m = _mlp()
+    it = FaultyIterator(_it(x, y), nan_at=5)   # inside window 2 (batches 4-7)
+    guard = TrainingGuard(policy=GuardPolicy.ROLLBACK, refresh_every=100)
+    m.fit(it, epochs=1, superstep=4, guard=guard)
+    _assert_bit_equal(ref.params, m.params)
+    assert (np.asarray(ref._rng) == np.asarray(m._rng)).all()
+    assert m.iteration_count == 4          # window 2 rolled back wholesale
+    assert guard.nonfinite_steps >= 1
+
+
+def test_fit_scan_alias_stages_epoch_window_once():
+    """The epoch-window regime re-presents the same batch objects every
+    epoch; staging must be memoized so multi-epoch fit_scan pays ONE
+    device stack like the historic implementation (review finding), and
+    the reused staged arrays must still train bit-exactly."""
+    from deeplearning4j_tpu.nn.multilayer import _NetworkSuperstepAdapter
+
+    x, y = _data(4 * 16)
+    calls = []
+    orig = _NetworkSuperstepAdapter.stage
+
+    def counting_stage(self, window):
+        calls.append(len(window))
+        return orig(self, window)
+
+    a = _mlp()
+    a.fit(_it(x, y), epochs=3)
+    b = _mlp()
+    try:
+        _NetworkSuperstepAdapter.stage = counting_stage
+        b.fit_scan(list(_it(x, y)), epochs=3)
+    finally:
+        _NetworkSuperstepAdapter.stage = orig
+    assert calls == [4]   # one stack for three epochs
+    _assert_bit_equal(a.params, b.params)
+
+
+def test_superstep_guard_halt_raises():
+    from deeplearning4j_tpu.fault.guard import NonFiniteScoreError
+
+    x, y = _data(4 * 16)
+    m = _mlp()
+    it = FaultyIterator(_it(x, y), nan_at=1)
+    with pytest.raises(NonFiniteScoreError):
+        m.fit(it, epochs=1, superstep=2,
+              guard=TrainingGuard(policy=GuardPolicy.HALT))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume at superstep granularity
+# ---------------------------------------------------------------------------
+def test_superstep_kill_mid_fit_resume_nonaligned(tmp_path):
+    """Kill mid-fit with the last checkpoint at batch 4 (a K=2 window
+    edge), then resume with K=3 — the resume ordinal is NOT aligned to the
+    new window length, windows regroup ([4..6],[7]) vs the uninterrupted
+    run's ([0..2],[3..5],[6..7]) — and still matches bit-exactly, because
+    window grouping never changes the math."""
+    d = str(tmp_path / "ckpt")
+    x, y = _data(8 * 16)
+
+    ref = _mlp()
+    ref.fit(_it(x, y), epochs=2, superstep=3)   # uninterrupted
+
+    m1 = _mlp()
+    it = FaultyIterator(_it(x, y), raise_at=6, exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        # K=2 windows; checkpoint_every=3 rounds up to the window edge at
+        # batch 4 — the last durable state before the injected kill
+        m1.fit(it, epochs=2, superstep=2, checkpoint_dir=d,
+               checkpoint_every=3)
+
+    m2 = _mlp()
+    m2.fit(_it(x, y), epochs=2, superstep=3, checkpoint_dir=d, resume=True)
+    _assert_bit_equal(ref.params, m2.params)
+    _assert_bit_equal(ref.updater_state, m2.updater_state)
+    assert (np.asarray(ref._rng) == np.asarray(m2._rng)).all()
+    assert ref.iteration_count == m2.iteration_count
+
+
+def test_superstep_resume_from_perbatch_checkpoint(tmp_path):
+    """A checkpoint written by the K=1 per-batch loop resumes through the
+    superstep loop (and vice versa): one training loop, one store."""
+    d = str(tmp_path / "ckpt")
+    x, y = _data(6 * 16)
+    ref = _mlp()
+    ref.fit(_it(x, y), epochs=1)
+
+    m1 = _mlp()
+    it = FaultyIterator(_it(x, y), raise_at=5, exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        m1.fit(it, epochs=1, checkpoint_dir=d, checkpoint_every=2)
+    m2 = _mlp()
+    m2.fit(_it(x, y), epochs=1, superstep="epoch", checkpoint_dir=d,
+           resume=True)
+    _assert_bit_equal(ref.params, m2.params)
+    assert ref.iteration_count == m2.iteration_count
+
+
+def test_checkpointer_on_batches_saves_at_window_edge(tmp_path):
+    """on_batches(n) advances the batch cursor a window at a time and the
+    interval save fires at the window edge with a consistent cursor."""
+    from deeplearning4j_tpu.fault.resume import (FitCheckpointer,
+                                                 maybe_fit_checkpointer)
+
+    d = str(tmp_path / "ckpt")
+    x, y = _data(8 * 16)   # two K=4 windows; the second edge's interval
+    m = _mlp()             # save is overwritten in place by fit_end
+    m.fit(_it(x, y), epochs=1, superstep=4, checkpoint_dir=d,
+          checkpoint_every=3)
+    # one interval save at the K=4 window edge + the fit_end save
+    import glob
+    import json
+    import zipfile
+    zips = sorted(glob.glob(d + "/ckpt_*.zip"))
+    metas = []
+    for z in zips:
+        with zipfile.ZipFile(z) as zf:
+            metas.append(json.loads(zf.read("metadata.json").decode()))
+    cursors = {mm.get("reason"): mm.get("batches_into_epoch")
+               for mm in metas}
+    assert cursors.get("interval") == 4      # window edge, not mid-window
+    assert cursors.get("fit_end") == 0
+
+
+# ---------------------------------------------------------------------------
+# ParallelTrainer composition
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("strategy", ["replicated", "zero1", "zero2"])
+def test_superstep_parallel_trainer(strategy):
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    x, y = _data(6 * 16)
+    t1 = ParallelTrainer(_mlp(), strategy=strategy)
+    t1.fit(_it(x, y), epochs=2)
+    t2 = ParallelTrainer(_mlp(), strategy=strategy)
+    t2.fit(_it(x, y), epochs=2, superstep=4)
+    assert t1.iteration_count == t2.iteration_count == 12
+    leaves1 = jax.tree_util.tree_leaves(t1.model.params)
+    leaves2 = jax.tree_util.tree_leaves(t2.model.params)
+    if strategy == "replicated":
+        for p, q in zip(leaves1, leaves2):
+            assert (np.asarray(p) == np.asarray(q)).all()
+    else:
+        # ZeRO: XLA may reassociate the step's collectives inside the scan
+        # body — allclose at float32 ulp scale, same as documented
+        for p, q in zip(leaves1, leaves2):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                       rtol=2e-6, atol=2e-7)
+
+
+def test_superstep_trainer_untrainable_batch_cursor_and_resume(tmp_path):
+    """A batch that trims to zero rows on the mesh (fewer rows than the
+    data axis) is consumed untrained; its cursor advance is deferred to
+    the next window EDGE (review finding: a mid-collection advance could
+    let a SIGTERM snapshot record a cursor ahead of the trained state).
+    Kill-mid-fit + resume around such a batch must match uninterrupted."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    x, y = _data(6 * 16)
+    runt = DataSet(x[:4], y[:4])   # 4 rows < n_data=8 -> trims to zero
+    batches = [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+               for i in range(6)]
+    seq = batches[:2] + [runt] + batches[2:]
+
+    ref = ParallelTrainer(_mlp(), strategy="replicated")
+    ref.fit(ListDataSetIterator(list(seq)), epochs=1, superstep=2)
+    assert ref.iteration_count == 6   # runt trained nothing
+
+    d = str(tmp_path / "ckpt")
+    t1 = ParallelTrainer(_mlp(), strategy="replicated")
+    it = FaultyIterator(ListDataSetIterator(list(seq)), raise_at=5,
+                        exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        t1.fit(it, epochs=1, superstep=2, checkpoint_dir=d,
+               checkpoint_every=1)
+    t2 = ParallelTrainer(_mlp(), strategy="replicated")
+    t2.fit(ListDataSetIterator(list(seq)), epochs=1, superstep=2,
+           checkpoint_dir=d, resume=True)
+    for p, q in zip(jax.tree_util.tree_leaves(ref.model.params),
+                    jax.tree_util.tree_leaves(t2.model.params)):
+        assert (np.asarray(p) == np.asarray(q)).all()
+    assert t2.iteration_count == ref.iteration_count
+
+
+def test_superstep_listener_replay_sees_own_window_params():
+    """With listeners attached, the pipelined loop finalizes window i
+    BEFORE dispatching window i+1, so a param-reading listener observes
+    end-of-its-own-window params — never a window ahead (review
+    finding)."""
+    from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+    x, y = _data(6 * 16)
+    snapshots = {}
+
+    class ParamProbe(IterationListener):
+        def iteration_done(self, model, iteration):
+            snapshots[iteration] = np.asarray(
+                jax.tree_util.tree_leaves(model.params)[0]).copy()
+
+    m = _mlp()
+    m.set_listeners(ParamProbe())
+    m.fit(_it(x, y), epochs=1, superstep=3)
+    # reference: train per-batch, record params after batches 3 and 6
+    ref = _mlp()
+    expect = {}
+    it = _it(x, y)
+    i = 0
+    while it.has_next():
+        ref.fit(it.next())
+        i += 1
+        expect[i] = np.asarray(jax.tree_util.tree_leaves(ref.params)[0])
+    # window edges: iterations 3 and 6 — replayed params must equal the
+    # per-batch params at those SAME iterations (end of own window)
+    assert (snapshots[3] == expect[3]).all()
+    assert (snapshots[6] == expect[6]).all()
+
+
+def test_superstep_trainer_guard_and_checkpoint(tmp_path):
+    """Guard + sharded checkpoints compose with the trainer superstep:
+    a NaN window rolls back to the pre-superstep snapshot and an
+    interval-saved run resumes to the uninterrupted result."""
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    x, y = _data(4 * 16)
+    ref = ParallelTrainer(_mlp(), strategy="replicated")
+    ref.fit(ArrayDataSetIterator(x[:2 * 16], y[:2 * 16], batch_size=16),
+            epochs=1, superstep=2)
+
+    t = ParallelTrainer(_mlp(), strategy="replicated")
+    it = FaultyIterator(_it(x, y), nan_at=2)   # window 2 (batches 2-3)
+    guard = TrainingGuard(policy=GuardPolicy.SKIP_BATCH)
+    t.fit(it, epochs=1, superstep=2, guard=guard)
+    assert t.iteration_count == 2
+    for p, q in zip(jax.tree_util.tree_leaves(ref.model.params),
+                    jax.tree_util.tree_leaves(t.model.params)):
+        assert (np.asarray(p) == np.asarray(q)).all()
+    assert guard.skipped_batches == 1
