@@ -126,6 +126,29 @@ def bench_pipeline(devices=8):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_accum(devices=8):
+    """Gradient-accumulation ablation (ISSUE 12): effective b256 via 8×b32
+    microbatch accumulation under ZERO2 (sharded fp32 accumulators,
+    per-microbatch bucketed reduce-scatter) vs the native b256 step, in
+    alternating paired windows on the virtual mesh. Reports the per-step
+    throughput ratio (gate >= 0.9), the sharded-vs-replicated accumulator
+    footprint (~1/N memory) and the structural collective/compute overlap
+    fraction."""
+    from deeplearning4j_tpu.util.platform import (
+        child_env_with_virtual_devices)
+
+    env = child_env_with_virtual_devices(devices)
+    out = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
+         "--devices", str(devices), "--mode", "accum", "--steps", "2",
+         "--reps", "3"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=2700)
+    if out.returncode != 0:
+        return None
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_checkpoint(reps=5):
     """Wall-clock ms for a crash-safe zip checkpoint save (atomic rename +
     sha256 manifest) and verified restore_into of the LeNet bench model —
@@ -283,6 +306,25 @@ def main():
                 extras["DP-t-rep-zero-ms"] = za.get("rep_ms")
             if sc.get("multichip"):
                 extras["DP-zero-multichip-gate"] = sc["multichip"]
+    except Exception:
+        pass
+    try:
+        # gradient accumulation (ISSUE 12): effective-b256 via 8×b32
+        # microbatch accumulation under ZERO2 vs native b256, paired
+        # alternating windows; throughput ratio + sharded-accumulator
+        # memory + structural collective/compute overlap fraction
+        ac = bench_accum(8)
+        if ac:
+            extras["DP-accum-8dev"] = {
+                "throughput_ratio_paired": ac.get(
+                    "throughput_ratio_paired"),
+                "throughput_ratio_spread": ac.get(
+                    "throughput_ratio_spread"),
+                "t_accum_step_ms": ac.get("t_accum_step_ms"),
+                "t_native_step_ms": ac.get("t_native_step_ms"),
+                "overlap_fraction": ac.get("overlap_fraction"),
+                "accumulator_bytes": ac.get("accumulator_bytes"),
+                "gate": ac.get("gate")}
     except Exception:
         pass
     try:

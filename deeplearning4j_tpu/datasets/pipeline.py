@@ -46,8 +46,10 @@ import numpy as np
 from .iterators import (AsyncDataSetIterator, DataSet, DataSetIterator,
                         MultiDataSet)
 
-__all__ = ["PadToBatchIterator", "DevicePrefetchIterator", "pad_dataset",
-           "pad_rows", "build_pipeline", "stage_window", "batch_nbytes"]
+__all__ = ["PadToBatchIterator", "DevicePrefetchIterator",
+           "MicrobatchSplitIterator", "pad_dataset", "pad_rows",
+           "build_pipeline", "split_microbatches", "stage_window",
+           "batch_nbytes"]
 
 
 # ---------------------------------------------------------------------------
@@ -300,6 +302,86 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
             return super()._fetch()
         with m[1].time():
             return super()._fetch()
+
+
+# ---------------------------------------------------------------------------
+# Microbatch splitting (gradient accumulation input side)
+# ---------------------------------------------------------------------------
+class MicrobatchSplitIterator(DataSetIterator):
+    """Slice every batch of `source` into consecutive microbatches of
+    `microbatch_size` rows (zero-copy numpy views) — the input-side half
+    of gradient accumulation. A big-batch pipeline composes with
+    `fit(grad_accumulation=M)` as::
+
+        it = split_microbatches(big_batch_iterator, b)   # B = M·b rows
+        model.fit(it, grad_accumulation=M)
+
+    and trains the IDENTICAL [M, b, ...] stacked windows a native
+    microbatch iterator over the same rows would: staging M contiguous
+    row-slices of one array equals reshaping that array to [M, b, ...],
+    so "one batch of M·b rows" and "M microbatches of b rows" are the
+    same bits by construction (tests/test_accumulation.py asserts the
+    equivalence). A source batch whose row count is not a multiple of
+    `microbatch_size` yields a smaller final slice — a signature change
+    that closes the accumulation group early, exactly like a ragged tail
+    (pad_ragged upstream keeps every slice full)."""
+
+    def __init__(self, source: DataSetIterator, microbatch_size: int):
+        if int(microbatch_size) < 1:
+            raise ValueError(
+                f"microbatch_size must be a positive int, got "
+                f"{microbatch_size!r}")
+        self.source = source
+        self.microbatch_size = int(microbatch_size)
+        self._pending = []
+
+    def _slices(self, ds):
+        n = ds.num_examples()
+        b = self.microbatch_size
+        if n <= b:
+            return [ds]
+        cut = lambda a, lo, hi: None if a is None else np.asarray(a)[lo:hi]
+        out = []
+        for lo in range(0, n, b):
+            hi = min(lo + b, n)
+            if isinstance(ds, MultiDataSet):
+                cl = lambda xs: (None if xs is None
+                                 else [cut(a, lo, hi) for a in xs])
+                out.append(MultiDataSet(features=cl(ds.features),
+                                        labels=cl(ds.labels),
+                                        features_masks=cl(ds.features_masks),
+                                        labels_masks=cl(ds.labels_masks)))
+            else:
+                out.append(DataSet(cut(ds.features, lo, hi),
+                                   cut(ds.labels, lo, hi),
+                                   cut(ds.features_mask, lo, hi),
+                                   cut(ds.labels_mask, lo, hi)))
+        return out
+
+    def reset(self):
+        self._pending = []
+        self.source.reset()
+
+    def has_next(self) -> bool:
+        return bool(self._pending) or self.source.has_next()
+
+    def next(self):
+        if not self._pending:
+            self._pending = self._slices(self.source.next())
+        return self._pending.pop(0)
+
+    def batch(self) -> int:
+        return self.microbatch_size
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)
+
+
+def split_microbatches(source: DataSetIterator, microbatch_size: int
+                       ) -> MicrobatchSplitIterator:
+    """Convenience constructor for `MicrobatchSplitIterator`."""
+    return MicrobatchSplitIterator(source, microbatch_size)
 
 
 # ---------------------------------------------------------------------------

@@ -205,6 +205,28 @@ class TrainingGuard:
             getattr(model, "iteration_count", "?"))
         return False
 
+    def note_skipped_micros(self, model, n: int):
+        """Accumulated-step skip accounting (nn/superstep.py): under
+        policy=skip_batch with grad_accumulation>1 a non-finite MICROBATCH
+        loss is neutralized in-trace — its gradient zeroed, the
+        accumulated mean renormalized over the finite microbatches — so
+        the optimizer step itself survives and no snapshot restore runs.
+        This only records that `n` microbatch contributions were dropped
+        (counters + telemetry + one warning); the consecutive-step circuit
+        breaker is untouched because the STEP was finite."""
+        n = int(n)
+        if n <= 0:
+            return
+        self.nonfinite_steps += n
+        self.skipped_batches += n
+        _m.count_nonfinite(self.policy, n)
+        log.warning(
+            "%d non-finite microbatch loss(es) near iteration %s — "
+            "gradient contribution(s) zeroed, accumulated step "
+            "renormalized over the finite microbatches "
+            "(policy=skip_batch, grad_accumulation)", n,
+            getattr(model, "iteration_count", "?"))
+
     # ------------------------------------------------------------------
     # transient-error retry around the data source
     # ------------------------------------------------------------------

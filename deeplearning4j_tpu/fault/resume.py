@@ -242,10 +242,16 @@ class FitCheckpointer:
     resumed run consumes exactly the batches the uninterrupted run would
     have."""
 
-    def __init__(self, store, every: int = 0, resume: bool = False):
+    def __init__(self, store, every: int = 0, resume: bool = False,
+                 context: Optional[Dict] = None):
         self.store = store
         self.every = max(0, int(every))
         self.resume = bool(resume)
+        # fit-call context recorded into every save's metadata — knobs
+        # that are part of the TRAINING MATH (grad_accumulation) so a
+        # resume with different values can warn instead of silently
+        # diverging from the uninterrupted run
+        self.context = dict(context or {})
         self._epoch_in_fit = 0
         self._batches = 0
         self._last_saved_iter = store.iteration()
@@ -262,6 +268,16 @@ class FitCheckpointer:
         meta = self.store.restore()
         if meta is None:
             return 0, 0
+        stored_m = meta.get("grad_accumulation")
+        cur_m = self.context.get("grad_accumulation")
+        if (stored_m is not None and cur_m is not None
+                and int(stored_m) != int(cur_m)):
+            log.warning(
+                "resuming with grad_accumulation=%s but the checkpoint "
+                "was written with grad_accumulation=%s — accumulation is "
+                "part of the training MATH (unlike superstep grouping), "
+                "so the resumed run will not match the uninterrupted one",
+                cur_m, stored_m)
         done = int(meta.get("epoch_in_fit", 0))
         skip = int(meta.get("batches_into_epoch", 0))
         self._epoch_in_fit = done
@@ -280,9 +296,11 @@ class FitCheckpointer:
 
     # ------------------------------------------------------------------
     def save(self, reason: str = "interval"):
-        self.store.save({"epoch_in_fit": self._epoch_in_fit,
-                         "batches_into_epoch": self._batches,
-                         "reason": reason})
+        extra = dict(self.context)
+        extra.update({"epoch_in_fit": self._epoch_in_fit,
+                      "batches_into_epoch": self._batches,
+                      "reason": reason})
+        self.store.save(extra)
         self._last_saved_iter = self.store.iteration()
 
     def maybe_save(self):
@@ -371,7 +389,8 @@ class FitCheckpointer:
 
 def maybe_fit_checkpointer(model, checkpoint_dir: Optional[str],
                            checkpoint_every: int, resume: bool,
-                           keep: int = 3) -> Optional[FitCheckpointer]:
+                           keep: int = 3, context: Optional[Dict] = None
+                           ) -> Optional[FitCheckpointer]:
     """Build the zip-backed checkpointer for a model fit, or None when
     checkpointing is off. Actionable error on inconsistent knobs."""
     if checkpoint_dir is None:
@@ -381,12 +400,14 @@ def maybe_fit_checkpointer(model, checkpoint_dir: Optional[str],
                 "(the directory checkpoints live in)")
         return None
     return FitCheckpointer(_ZipModelStore(model, checkpoint_dir, keep=keep),
-                           every=checkpoint_every, resume=resume)
+                           every=checkpoint_every, resume=resume,
+                           context=context)
 
 
 def sharded_fit_checkpointer(trainer, checkpoint_dir: Optional[str],
                              checkpoint_every: int, resume: bool,
-                             keep: int = 3) -> Optional[FitCheckpointer]:
+                             keep: int = 3, context: Optional[Dict] = None
+                             ) -> Optional[FitCheckpointer]:
     """Sharded (orbax) checkpointer for ParallelTrainer fits."""
     if checkpoint_dir is None:
         if resume or checkpoint_every:
@@ -395,4 +416,4 @@ def sharded_fit_checkpointer(trainer, checkpoint_dir: Optional[str],
         return None
     return FitCheckpointer(
         _ShardedTrainerStore(trainer, checkpoint_dir, keep=keep),
-        every=checkpoint_every, resume=resume)
+        every=checkpoint_every, resume=resume, context=context)

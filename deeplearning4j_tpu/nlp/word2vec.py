@@ -433,15 +433,16 @@ class SequenceVectors(WordVectorsModel):
         the padded scan) — replaces a per-epoch host upload."""
         fn = getattr(self, "_sg_pos_fn", None)
         if fn is None:
-            import functools
+            from ..telemetry.compile_watch import watch_compiles
 
-            @functools.partial(jax.jit, static_argnums=(1, 2, 3))
-            def fn(key, n, T2, B):
+            def pos(key, n, T2, B):
                 perm = jax.random.permutation(key, n)
                 reps = -(-T2 * B // n)
                 return jnp.tile(perm, reps)[:T2 * B].reshape(
                     T2, B).astype(jnp.int32)
 
+            fn = watch_compiles(jax.jit(pos, static_argnums=(1, 2, 3)),
+                                "nlp/sg_positions")
             self._sg_pos_fn = fn
         return self._sg_place_positions(fn(key, n, T2, B))
 
@@ -551,11 +552,14 @@ class ParagraphVectors(SequenceVectors):
                 "d,nkd->nk", v, un)), axis=-1)
             return -jnp.sum(pos + neg)
 
-        @jax.jit
+        from ..telemetry.compile_watch import watch_compiles
+
         def step(v, lr, k):
             negs = sampler.sample(k, (len(idx), max(1, table.negative)))
             l, g = jax.value_and_grad(loss_fn)(v, negs)
             return v - lr * g, l
+
+        step = watch_compiles(jax.jit(step), "nlp/infer_step")
 
         for t in range(steps):
             rng, k = jax.random.split(rng)

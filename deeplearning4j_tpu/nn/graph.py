@@ -414,6 +414,57 @@ class ComputationGraph:
         return self._make_train_step()
 
     @functools.cached_property
+    def grad_step_fn(self):
+        """Gradient half of the graph train step — ``(params, state,
+        inputs, labels, rng, fmasks, lmasks) -> (score, new_state,
+        grads)`` with remat="full" and the minimize sign folded in
+        (MultiLayerNetwork.grad_step_fn counterpart; composed by the
+        accumulation superstep and the ZeRO step)."""
+        base_loss = self._loss_fn
+        if self.conf.conf.remat == "full":
+            def loss_fn(params, state, inputs, labels, rng,
+                        fmasks=None, lmasks=None):
+                f = lambda p, s, i_, l_, r_: base_loss(
+                    p, s, i_, l_, r_, fmasks=fmasks, lmasks=lmasks)
+                return jax.checkpoint(f)(params, state, inputs, labels, rng)
+        else:
+            loss_fn = base_loss
+        minimize = self.conf.conf.minimize
+
+        def grad_step(params, state, inputs, labels, rng, fmasks, lmasks):
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, inputs, labels, rng,
+                                       fmasks=fmasks, lmasks=lmasks)
+            if not minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            return score, new_state, grads
+
+        return grad_step
+
+    def apply_updates(self, params, grads, opt_state, step):
+        """Update half on a full gradient tree (apply_vertex_updates under
+        the shared grad/update split the accumulation and ZeRO steps
+        compose). Pure/traceable."""
+        return self.apply_vertex_updates(params, grads, opt_state, step)
+
+    def _accum_superstep_fn(self, skip_nonfinite: bool):
+        """Jitted accumulated superstep over stacked input/label DICT
+        windows [K, M, batch, ...] (None mask leaves pass through as
+        static absence) — see nn/superstep.build_accum_superstep. Cached
+        per skip flag; K/M are shape-derived."""
+        cache = self.__dict__.setdefault("_accum_superstep_cache", {})
+        fn = cache.get(bool(skip_nonfinite))
+        if fn is None:
+            from .superstep import build_accum_superstep
+            fn = cache[bool(skip_nonfinite)] = watch_compiles(
+                jax.jit(build_accum_superstep(self.grad_step_fn,
+                                              self.apply_updates,
+                                              bool(skip_nonfinite)),
+                        donate_argnums=(0, 1, 2)),
+                "graph/accum_superstep")
+        return fn
+
+    @functools.cached_property
     def _train_step(self):
         return watch_compiles(
             jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2)),
@@ -484,7 +535,7 @@ class ComputationGraph:
     # Public API
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1, *, superstep=1,
-            prefetch: bool = False,
+            grad_accumulation: int = 1, prefetch: bool = False,
             pad_ragged: bool = False, time_buckets=None,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
             resume: bool = False, guard=None):
@@ -498,8 +549,16 @@ class ComputationGraph:
         jitted `lax.scan` dispatch — bit-identical to the K=1 per-batch
         loop, with listeners/guard/checkpoints firing at superstep edges
         on the per-window loss vector (see nn/superstep.py). "auto" sizes
-        K from batch bytes, "epoch" windows the whole epoch. Line-search
-        optimizers fall back to per-batch dispatch.
+        K from batch bytes and adapts it to the measured dispatch/compute
+        ratio, "epoch" windows the whole epoch. Line-search optimizers
+        fall back to per-batch dispatch.
+
+        `grad_accumulation=M` accumulates M consecutive iterator
+        microbatches into one optimizer step (fp32 accumulators, update on
+        the mean — effective batch M·b at b's activation memory), exactly
+        as on `MultiLayerNetwork.fit`; composes with `superstep` (windows
+        of K·M microbatches), listener/checkpoint cadence per optimizer
+        step.
 
         Fault-tolerance knobs (`checkpoint_dir`/`checkpoint_every`/
         `resume`/`guard`) behave exactly as on `MultiLayerNetwork.fit`:
@@ -507,6 +566,8 @@ class ComputationGraph:
         replays counters/RNG/shuffle epoch so it matches an uninterrupted
         run, and a TrainingGuard applying its non-finite-loss policy per
         batch (see fault/)."""
+        from .superstep import validate_grad_accumulation
+        accum_m = validate_grad_accumulation(grad_accumulation)
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -514,6 +575,10 @@ class ComputationGraph:
                 raise ValueError(
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress)")
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} needs an iterator fit "
+                    "(M consecutive microbatches form one optimizer step)")
             if superstep != 1:
                 log.info("superstep=%r ignored for a single-DataSet fit "
                          "(one batch is one step); pass an iterator to "
@@ -525,13 +590,14 @@ class ComputationGraph:
             return self
         from ..fault.resume import maybe_fit_checkpointer
         ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
-                                      resume)
+                                      resume,
+                                      context={"grad_accumulation": accum_m})
         skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
-        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        runner = self._make_superstep_runner(superstep, guard, ckpt, accum_m)
         if runner is not None:
             runner.skip(skip)
             skip = 0
@@ -569,22 +635,32 @@ class ComputationGraph:
             close()
         return self
 
-    def _make_superstep_runner(self, superstep, guard, ckpt):
+    def _make_superstep_runner(self, superstep, guard, ckpt, accum_m=1):
         """SuperstepRunner for this fit, or None for the per-batch loop
-        (superstep=1 or a line-search optimizer)."""
+        (superstep=1 with grad_accumulation=1, or a line-search
+        optimizer — which rejects M>1 rather than silently changing the
+        effective batch)."""
         from .conf import OptimizationAlgorithm as OA
-        from .superstep import SuperstepRunner, validate_superstep
+        from .superstep import (SuperstepRunner, accum_skip_nonfinite,
+                                validate_superstep)
 
         k = validate_superstep(superstep)
-        if k == 1:
+        if k == 1 and accum_m == 1:
             return None
         if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} is not supported with "
+                    "line-search optimizers (per-batch sequential)")
             log.info("superstep=%r falls back to per-batch dispatch: "
                      "line-search optimizers are per-batch sequential",
                      superstep)
             return None
-        return SuperstepRunner(self, _GraphSuperstepAdapter(self), k,
-                               guard=guard, ckpt=ckpt)
+        adapter = _GraphSuperstepAdapter(
+            self, m=accum_m,
+            skip_nonfinite=accum_skip_nonfinite(guard, accum_m))
+        return SuperstepRunner(self, adapter, k, guard=guard, ckpt=ckpt,
+                               grad_accumulation=accum_m)
 
     @_functools.cached_property
     def _superstep_fn(self):
@@ -905,10 +981,15 @@ class _GraphSuperstepAdapter:
     """SuperstepRunner hooks for ComputationGraph (see nn/superstep.py):
     batches are dicts keyed by input/output name (DataSet or MultiDataSet
     sources), masks are dicts whose values may be None — None leaves pass
-    through the scan as the same static absence the per-batch step sees."""
+    through the scan as the same static absence the per-batch step sees.
+    With ``m>1`` dispatch routes the window through the accumulated
+    superstep in [K, M] groups."""
 
-    def __init__(self, net: ComputationGraph):
+    def __init__(self, net: ComputationGraph, m: int = 1,
+                 skip_nonfinite: bool = False):
         self.net = net
+        self.m = int(m)
+        self.skip_nonfinite = bool(skip_nonfinite)
 
     @staticmethod
     def _shape(a):
@@ -940,12 +1021,25 @@ class _GraphSuperstepAdapter:
 
     def dispatch(self, staged, n, step0):
         net = self.net
-        xs, ys, fms, lms = staged
-        (net.params, net.state, net.updater_state, net._rng,
-         scores) = net._superstep_fn(
-            net.params, net.state, net.updater_state,
-            jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fms, lms)
-        return scores
+        if self.m == 1:
+            xs, ys, fms, lms = staged
+            (net.params, net.state, net.updater_state, net._rng,
+             scores) = net._superstep_fn(
+                net.params, net.state, net.updater_state,
+                jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fms, lms)
+            return scores
+        from .superstep import dispatch_accum_groups
+        fn = net._accum_superstep_fn(self.skip_nonfinite)
+
+        def run_group(seg, step):
+            xs, ys, fms, lms = seg
+            (net.params, net.state, net.updater_state, net._rng, scores,
+             mscores) = fn(net.params, net.state, net.updater_state,
+                           jnp.asarray(step, jnp.int32), net._rng,
+                           xs, ys, fms, lms)
+            return scores, mscores
+
+        return dispatch_accum_groups(staged, n, self.m, step0, run_group)
 
     def on_window_end(self, window):
         last = window[-1]

@@ -354,6 +354,61 @@ class MultiLayerNetwork:
         return self._make_train_step()
 
     @functools.cached_property
+    def grad_step_fn(self):
+        """The GRADIENT half of the train step — ``(params, state, x, y,
+        rng, fmask, lmask) -> (score, new_state, grads)`` with the loss
+        selection (remat="full") and the minimize sign folded in. The
+        accumulation superstep and the ZeRO step compose it with their own
+        reduction/update schedule (nn/superstep.py, parallel/zero.py)."""
+        base_loss = self._loss_fn
+        if self.conf.conf.remat == "full":
+            def loss_fn(params, state, x, y, rng, fmask=None, lmask=None):
+                f = lambda p, s, x_, y_, r_: base_loss(
+                    p, s, x_, y_, r_, fmask=fmask, lmask=lmask)
+                return jax.checkpoint(f)(params, state, x, y, rng)
+        else:
+            loss_fn = base_loss
+        minimize = self.conf.conf.minimize
+
+        def grad_step(params, state, x, y, rng, fmask, lmask):
+            (score, (new_state, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, x, y, rng,
+                                       fmask=fmask, lmask=lmask)
+            if not minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            return score, new_state, grads
+
+        return grad_step
+
+    def apply_updates(self, params, grads, opt_state, step):
+        """The UPDATE half on a full gradient tree — per-layer gradient
+        normalization, scheduled/per-layer lr and bias-lr rescale — the
+        counterpart of `grad_step_fn` for callers that schedule the
+        gradient themselves (accumulated mean, ZeRO-reduced shards).
+        Pure/traceable."""
+        new_params, new_opt = self.apply_layer_updates(
+            self.layers, params, grads, opt_state, step)
+        return tuple(new_params), tuple(new_opt)
+
+    def _accum_superstep_fn(self, skip_nonfinite: bool):
+        """Jitted accumulated superstep (nn/superstep.py): nested scan over
+        [K, M, batch, ...] windows, fp32 gradient accumulators, one update
+        per outer step. Cached per skip flag only — K and M are read from
+        the input shapes, so one jit serves every grouping (each distinct
+        (K, M, signature) costs one XLA compile, like ragged tails)."""
+        cache = self.__dict__.setdefault("_accum_superstep_cache", {})
+        fn = cache.get(bool(skip_nonfinite))
+        if fn is None:
+            from .superstep import build_accum_superstep
+            fn = cache[bool(skip_nonfinite)] = watch_compiles(
+                jax.jit(build_accum_superstep(self.grad_step_fn,
+                                              self.apply_updates,
+                                              bool(skip_nonfinite)),
+                        donate_argnums=(0, 1, 2)),
+                "nn/accum_superstep")
+        return fn
+
+    @functools.cached_property
     def _train_step(self):
         return watch_compiles(
             jax.jit(self.train_step_fn, donate_argnums=(0, 1, 2)),
@@ -414,7 +469,8 @@ class MultiLayerNetwork:
     # Public training API
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, *,
-            superstep=1, prefetch: bool = False, pad_ragged: bool = False,
+            superstep=1, grad_accumulation: int = 1,
+            prefetch: bool = False, pad_ragged: bool = False,
             time_buckets=None, checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0, resume: bool = False, guard=None):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels).
@@ -424,12 +480,30 @@ class MultiLayerNetwork:
         `lax.scan` dispatch per window instead of one per batch, killing
         the per-batch host-dispatch floor while staying BIT-IDENTICAL to
         K=1 (see nn/superstep.py). K=1 (default) is the classic per-batch
-        loop; "auto" sizes the window from batch bytes; "epoch" windows
+        loop; "auto" sizes the window from batch bytes AND adapts K to the
+        measured dispatch/compute ratio (overlap-aware); "epoch" windows
         the whole epoch (the fit_scan regime). Listeners, `guard` checks
         and checkpoint/SIGTERM saves fire at superstep edges with the
         per-window loss vector; ragged tails just close a window early.
         Falls back to per-batch dispatch (with a log line) for
         line-search optimizers and TBPTT configs.
+
+        `grad_accumulation=M` (iterator inputs) accumulates M consecutive
+        iterator microbatches into ONE optimizer step: forward/backward
+        per microbatch, gradients summed in fp32 accumulators, one update
+        on the mean — the effective batch is M·b at the activation memory
+        of b. Equivalent to training on the concatenated M·b batch (exact
+        arithmetic; bitwise up to XLA's reassociation of the batch
+        reduction — see nn/superstep.build_accum_superstep). Composes
+        with `superstep` (a window = K·M microbatches) and is
+        grouping-invariant bitwise across K. Listeners/iteration_count/
+        lr schedules advance per optimizer step; checkpoint cadence lands
+        on optimizer-step boundaries; an epoch tail (or signature change)
+        shorter than M trains as one step renormalized over its
+        microbatches. Resume must use the SAME M (the checkpoint records
+        it and resume warns on a mismatch). Line-search optimizers and
+        TBPTT reject M>1 (silently changing the effective batch would be
+        worse than an error).
 
         Input-pipeline knobs (iterator inputs only; see
         `datasets/pipeline.py`):
@@ -462,6 +536,8 @@ class MultiLayerNetwork:
                              every step's loss (warn/skip_batch/rollback/
                              halt) + bounded-backoff retry around
                              iterator.next() for transient data errors."""
+        from .superstep import validate_grad_accumulation
+        accum_m = validate_grad_accumulation(grad_accumulation)
         if self.params is None:
             self.init()
         if labels is not None:
@@ -472,6 +548,14 @@ class MultiLayerNetwork:
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress); wrap the "
                     "DataSet in a ListDataSetIterator")
+            if accum_m != 1:
+                # silently training one b-row step where the caller asked
+                # for an M·b effective batch would be a correctness trap
+                raise ValueError(
+                    f"grad_accumulation={accum_m} needs an iterator fit "
+                    "(M consecutive microbatches form one optimizer "
+                    "step); wrap the DataSet in a ListDataSetIterator or "
+                    "split it with datasets.pipeline.split_microbatches")
             if superstep != 1:
                 log.info("superstep=%r ignored for a single-DataSet fit "
                          "(one batch is one step); pass an iterator to "
@@ -488,21 +572,23 @@ class MultiLayerNetwork:
             self._pretrained = True
         if not self.conf.backprop:
             if (checkpoint_dir is not None or resume or checkpoint_every
-                    or guard is not None):
+                    or guard is not None or accum_m != 1):
                 raise ValueError(
-                    "checkpoint_dir/checkpoint_every/resume/guard need a "
-                    "backprop fit — this configuration has backprop=False, "
-                    "so none of them would take effect")
+                    "checkpoint_dir/checkpoint_every/resume/guard/"
+                    "grad_accumulation need a backprop fit — this "
+                    "configuration has backprop=False, so none of them "
+                    "would take effect")
             return self
         from ..fault.resume import maybe_fit_checkpointer
         ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
-                                      resume)
+                                      resume,
+                                      context={"grad_accumulation": accum_m})
         skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
-        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        runner = self._make_superstep_runner(superstep, guard, ckpt, accum_m)
         if runner is not None:
             runner.skip(skip)
             skip = 0
@@ -550,14 +636,18 @@ class MultiLayerNetwork:
             close()
         return self
 
-    def _make_superstep_runner(self, superstep, guard, ckpt):
+    def _make_superstep_runner(self, superstep, guard, ckpt, accum_m=1):
         """SuperstepRunner for this fit, or None for the per-batch loop
-        (superstep=1, line-search optimizers, TBPTT)."""
+        (superstep=1 with grad_accumulation=1, line-search optimizers,
+        TBPTT). grad_accumulation>1 always needs the windowed loop; on
+        configs that can't window it raises instead of silently training
+        with a different effective batch."""
         from .conf import OptimizationAlgorithm as OA
-        from .superstep import SuperstepRunner, validate_superstep
+        from .superstep import (SuperstepRunner, accum_skip_nonfinite,
+                                validate_superstep)
 
         k = validate_superstep(superstep)
-        if k == 1:
+        if k == 1 and accum_m == 1:
             return None
         reason = None
         if self.conf.conf.optimization_algo != OA.STOCHASTIC_GRADIENT_DESCENT:
@@ -567,11 +657,18 @@ class MultiLayerNetwork:
             reason = ("TBPTT chunks each batch on host; use fit_scan for "
                       "device-resident TBPTT epochs")
         if reason is not None:
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} is not supported for "
+                    f"this configuration: {reason}")
             log.info("superstep=%r falls back to per-batch dispatch: %s",
                      superstep, reason)
             return None
-        return SuperstepRunner(self, _NetworkSuperstepAdapter(self), k,
-                               guard=guard, ckpt=ckpt)
+        adapter = _NetworkSuperstepAdapter(
+            self, m=accum_m,
+            skip_nonfinite=accum_skip_nonfinite(guard, accum_m))
+        return SuperstepRunner(self, adapter, k, guard=guard, ckpt=ckpt,
+                               grad_accumulation=accum_m)
 
     # ------------------------------------------------------------------
     # Device-resident epoch training (one dispatch per epoch)
@@ -1273,10 +1370,14 @@ class MultiLayerNetwork:
 
 class _NetworkSuperstepAdapter:
     """SuperstepRunner hooks for MultiLayerNetwork (see nn/superstep.py):
-    array-shaped batches, masks optional."""
+    array-shaped batches, masks optional. With ``m>1`` dispatch routes the
+    window through the accumulated superstep in [K, M] groups."""
 
-    def __init__(self, net: MultiLayerNetwork):
+    def __init__(self, net: MultiLayerNetwork, m: int = 1,
+                 skip_nonfinite: bool = False):
         self.net = net
+        self.m = int(m)
+        self.skip_nonfinite = bool(skip_nonfinite)
 
     @staticmethod
     def _shape(a):
@@ -1301,12 +1402,25 @@ class _NetworkSuperstepAdapter:
 
     def dispatch(self, staged, n, step0):
         net = self.net
-        xs, ys, fm, lm = staged
-        (net.params, net.state, net.updater_state, net._rng,
-         scores) = net._superstep_fn(
-            net.params, net.state, net.updater_state,
-            jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fm, lm)
-        return scores
+        if self.m == 1:
+            xs, ys, fm, lm = staged
+            (net.params, net.state, net.updater_state, net._rng,
+             scores) = net._superstep_fn(
+                net.params, net.state, net.updater_state,
+                jnp.asarray(step0, jnp.int32), net._rng, xs, ys, fm, lm)
+            return scores
+        from .superstep import dispatch_accum_groups
+        fn = net._accum_superstep_fn(self.skip_nonfinite)
+
+        def run_group(seg, step):
+            xs, ys, fm, lm = seg
+            (net.params, net.state, net.updater_state, net._rng, scores,
+             mscores) = fn(net.params, net.state, net.updater_state,
+                           jnp.asarray(step, jnp.int32), net._rng,
+                           xs, ys, fm, lm)
+            return scores, mscores
+
+        return dispatch_accum_groups(staged, n, self.m, step0, run_group)
 
     def on_window_end(self, window):
         net = self.net

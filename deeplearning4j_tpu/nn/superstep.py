@@ -41,10 +41,23 @@ stacked and transferred (``datasets/pipeline.py`` staging) while the
 current superstep computes on device, and the loss sync for window i
 happens after window i+1 has been dispatched. The device never waits on
 host batch assembly.
+
+Gradient accumulation (ISSUE 12): ``fit(..., grad_accumulation=M)`` runs
+M consecutive iterator microbatches per OPTIMIZER step — forward/backward
+per microbatch, gradients summed in fp32 accumulators, ONE update on the
+mean — so the effective batch is M·b with activation memory for b.
+Composes with supersteps: a window holds K·M microbatches scanned as a
+nested ``lax.scan`` (outer K optimizer steps, inner M microbatches), and
+``superstep="auto"`` is now overlap-aware — the byte budget seeds K, then
+``OverlapAutoK`` grows it from the measured dispatch/compute ratio.
+Listeners, guard checks and ``iteration_count`` operate per optimizer
+step; the checkpoint batch cursor keeps counting iterator microbatches
+and only ever lands on optimizer-step boundaries (window edges).
 """
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import numpy as np
@@ -53,14 +66,33 @@ from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["AUTO_WINDOW_BYTES", "AUTO_MAX_K", "EPOCH", "auto_superstep_k",
-           "validate_superstep", "build_superstep", "SuperstepRunner"]
+__all__ = ["AUTO_WINDOW_BYTES", "AUTO_MAX_K", "AUTO_ADAPT_MAX_K",
+           "AUTO_DISPATCH_SHARE", "EPOCH", "auto_superstep_k",
+           "validate_superstep", "validate_grad_accumulation",
+           "accum_skip_nonfinite", "build_superstep",
+           "build_accum_superstep", "dispatch_accum_groups",
+           "split_accum_groups", "steps_in", "OverlapAutoK",
+           "SuperstepRunner"]
 
 #: ``superstep="auto"`` sizes the window so its stacked device footprint
 #: stays near this budget — big enough to amortize dispatch, small enough
 #: that window staging never competes with model state for memory.
 AUTO_WINDOW_BYTES = 64 << 20
 AUTO_MAX_K = 32
+#: overlap-aware ``superstep="auto"`` may GROW K past the byte-budget
+#: seed while the measured dispatch share stays above target — bounded
+#: here so the growth (one extra XLA compile per doubling) terminates.
+AUTO_ADAPT_MAX_K = 256
+#: hard byte ceiling for the GROWN window: adaptation may trade staging
+#: memory for dispatch amortization up to this much (8x the seed
+#: budget), never further — a dispatch-bound fit with large batches must
+#: not double itself into staging OOM (2 windows are in flight under the
+#: pipelined loop).
+AUTO_ADAPT_WINDOW_BYTES = AUTO_WINDOW_BYTES * 8
+#: target host-dispatch share of the window period for the overlap-aware
+#: auto-K: below this, per-window dispatch overhead is noise; above it,
+#: the window is too short to hide the host work and K doubles.
+AUTO_DISPATCH_SHARE = 0.10
 #: ``superstep="epoch"``: the window is bounded only by the epoch (and by
 #: signature changes) — the fit_scan regime expressed through fit().
 EPOCH = "epoch"
@@ -127,6 +159,217 @@ def build_superstep(step_fn):
     return superstep
 
 
+def validate_grad_accumulation(m):
+    """Normalize the ``grad_accumulation=`` knob: a positive int (number of
+    microbatches accumulated per optimizer step; 1 = classic one batch =
+    one step)."""
+    try:
+        mi = int(m)
+    except (TypeError, ValueError):
+        mi = 0
+    if mi < 1 or (not isinstance(m, (int, np.integer))):
+        raise ValueError(
+            f"grad_accumulation={m!r} — expected a positive int: the number "
+            "of consecutive iterator microbatches whose gradients accumulate "
+            "into one optimizer step (1 = no accumulation)")
+    return mi
+
+
+def accum_skip_nonfinite(guard, m) -> bool:
+    """True when the accumulated step must neutralize non-finite
+    microbatches IN-TRACE: under ``GuardPolicy.SKIP_BATCH`` a bad
+    microbatch loss zeroes only that microbatch's gradient and the mean
+    renormalizes over the finite ones — the rest of the accumulated step
+    survives (ISSUE 12 satellite). Other policies keep the per-step
+    semantics: the NaN propagates into the step score and the guard
+    handles the whole step (warn/rollback/halt)."""
+    return (m > 1 and guard is not None
+            and getattr(guard, "policy", None) == "skip_batch")
+
+
+def build_accum_superstep(grad_fn, update_fn, skip_nonfinite: bool = False):
+    """The raw (unjitted) ACCUMULATED superstep: a nested ``lax.scan`` over
+    a [K, M, batch, ...] window — outer over K optimizer steps, inner over
+    each step's M microbatches, the update applied once per outer step on
+    the fp32 mean gradient.
+
+    ``grad_fn(params, state, x, y, rng, fmask, lmask) -> (score, new_state,
+    grads)`` is a family's gradient half (loss selection incl. remat and
+    the minimize sign already folded in); ``update_fn(params, grads,
+    opt_state, step) -> (params, opt_state)`` its update half (gradient
+    normalization, per-layer lr, bias-lr rescale). Both model families and
+    the ZeRO step (which owns its own reduction — see
+    ``parallel/zero.py.make_zero_accum_superstep``) fit this split.
+
+    Semantics:
+      * Gradients accumulate by SUMMATION in float32 accumulators and the
+        update sees their mean — in exact arithmetic identical to one
+        batch of M·b rows (each microbatch loss is a mean over its rows),
+        and grouping-invariant bitwise: any (K, M) regrouping of the same
+        microbatch sequence produces identical bits, because the op
+        sequence per microbatch is identical. Against a NATIVE M·b batch
+        the only difference is XLA's reassociation of the batch reduction
+        — allclose at f32-ulp, asserted in tests/test_accumulation.py.
+      * The RNG split chain advances per MICROBATCH (each microbatch draws
+        its own dropout key, exactly as the per-batch loop would for the
+        same iterator batches); the step counter advances per OPTIMIZER
+        step, so updater bias correction and lr schedules see optimizer
+        steps, not microbatches.
+      * M is read from the input shape — one traced builder serves every
+        M (a ragged tail group of m < M microbatches compiles its own
+        shape and renormalizes by m, like a smaller final batch).
+      * ``skip_nonfinite`` (static): a non-finite microbatch loss
+        contributes a ZERO gradient and drops out of the mean's
+        denominator; the step score averages the finite microbatches only
+        (NaN when every microbatch was bad, so the guard still catches a
+        fully-poisoned step). The raw per-microbatch scores are returned
+        alongside so the host can count the skips.
+
+    Returns ``(params, state, opt, rng, scores[K], micro_scores[K, M])``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def superstep(params, state, opt_state, step0, rng0, xs, ys, fm, lm):
+        f32 = jnp.float32
+
+        def opt_body(carry, inp):
+            params, state, opt, step, rng = carry
+
+            def micro_body(mcarry, minp):
+                state, rng, acc, n_ok, ssum = mcarry
+                x, y, f, l = minp
+                rng, k = jax.random.split(rng)
+                score, new_state, grads = grad_fn(params, state, x, y, k,
+                                                  f, l)
+                if skip_nonfinite:
+                    # where-select, never multiply: 0 * NaN is NaN, and a
+                    # poisoned gradient/state must not touch the carry
+                    ok = jnp.isfinite(score)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + jnp.where(ok, g.astype(f32), 0.0),
+                        acc, grads)
+                    state = jax.tree_util.tree_map(
+                        lambda o, n_: jnp.where(ok, n_, o), state,
+                        new_state)
+                    n_ok = n_ok + ok.astype(f32)
+                    ssum = ssum + jnp.where(ok, score, 0.0)
+                else:
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(f32), acc, grads)
+                    state = new_state
+                    n_ok = n_ok + 1.0
+                    ssum = ssum + score
+                return (state, rng, acc, n_ok, ssum), score
+
+            acc0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), f32), params)
+            (state, rng, acc, n_ok, ssum), mscores = jax.lax.scan(
+                micro_body, (state, rng, acc0, f32(0.0), f32(0.0)), inp)
+            denom = jnp.maximum(n_ok, 1.0)
+            gmean = jax.tree_util.tree_map(
+                lambda a, p: (a / denom).astype(jnp.result_type(p)),
+                acc, params)
+            params, opt = update_fn(params, gmean, opt, step)
+            # all-microbatches-bad: 0/0 -> NaN, the step score the guard's
+            # whole-step policies key on
+            score = jnp.where(n_ok > 0, ssum / denom, jnp.nan)
+            return (params, state, opt, step + 1, rng), (score, mscores)
+
+        (params, state, opt, _step, rng), (scores, mscores) = jax.lax.scan(
+            opt_body, (params, state, opt_state, step0, rng0),
+            (xs, ys, fm, lm))
+        return params, state, opt, rng, scores, mscores
+
+    return superstep
+
+
+def steps_in(n_micro: int, m: int) -> int:
+    """Optimizer steps a window of `n_micro` microbatches trains under
+    grad_accumulation=m: full M-groups plus one renormalized step for any
+    remainder."""
+    q, r = divmod(int(n_micro), int(m))
+    return q + (1 if r else 0)
+
+
+def dispatch_accum_groups(staged, n_micro: int, m: int, step0: int,
+                          run_group):
+    """Drive a staged window through the accumulated superstep one
+    [K', M'] group at a time (see `split_accum_groups`): ``run_group(tree,
+    step0)`` dispatches one group — rebinding its model's trees — and
+    returns the group's (scores, micro_scores) device arrays. Returns the
+    parts list in step order, the M>1 dispatch contract
+    ``SuperstepRunner._finalize`` consumes. Shared by all three adapters
+    so the group/step-counter arithmetic lives in one place."""
+    parts, step = [], int(step0)
+    for seg, q, _m_eff in split_accum_groups(staged, n_micro, m):
+        parts.append(run_group(seg, step))
+        step += q
+    return parts
+
+
+def split_accum_groups(staged, n_micro: int, m: int):
+    """Split a staged [n_micro, batch, ...] window into accumulation
+    groups: the full [q, M, batch, ...] part plus (when n_micro is not a
+    multiple of M — an epoch tail or a signature change that closed the
+    group early) a [1, r, batch, ...] remainder that trains as ONE
+    optimizer step renormalized over its r microbatches. None leaves
+    (absent masks) pass through. Returns [(tree, n_steps, m_eff), ...]."""
+    import jax
+
+    q, r = divmod(int(n_micro), int(m))
+
+    def cut(lo, hi, k, mm):
+        return jax.tree_util.tree_map(
+            lambda a: (None if a is None else
+                       a[lo:hi].reshape((k, mm) + a.shape[1:])),
+            staged, is_leaf=lambda x: x is None)
+
+    parts = []
+    if q:
+        parts.append((cut(0, q * m, q, m), q, m))
+    if r:
+        parts.append((cut(q * m, n_micro, 1, r), 1, r))
+    return parts
+
+
+class OverlapAutoK:
+    """Overlap-aware ``superstep="auto"`` sizing (ISSUE 12): the byte
+    budget only seeds K; from there K adapts to the MEASURED
+    dispatch/compute ratio. Each full window reports (host seconds spent
+    inside the dispatch call, wall seconds of the whole window period);
+    EMAs smooth sandbox noise, and while the dispatch share of the period
+    exceeds ``target_share`` K doubles — each growth costs one extra XLA
+    compile, so growth is geometric and capped at ``max_k``. K never
+    shrinks: a long window is at worst slightly stale for listeners,
+    while thrash between two K values would pay compiles forever.
+    Bit-exactness is unaffected — window grouping never changes the math
+    (nn/superstep.py header)."""
+
+    def __init__(self, k0: int, max_k: int = AUTO_ADAPT_MAX_K,
+                 target_share: float = AUTO_DISPATCH_SHARE):
+        self.k = max(1, int(k0))
+        self.max_k = max(self.k, int(max_k))
+        self.target_share = float(target_share)
+        self._disp = 0.0
+        self._period = 0.0
+
+    def observe(self, dispatch_s: float, period_s: float) -> int:
+        """Feed one full window's timings; returns the (possibly grown)
+        K for the next window."""
+        if period_s <= 0.0:
+            return self.k
+        if self._period == 0.0:
+            self._disp, self._period = dispatch_s, period_s
+        else:
+            self._disp = 0.5 * dispatch_s + 0.5 * self._disp
+            self._period = 0.5 * period_s + 0.5 * self._period
+        if (self.k < self.max_k
+                and self._disp / self._period > self.target_share):
+            self.k = min(self.max_k, self.k * 2)
+        return self.k
+
+
 class SuperstepRunner:
     """The windowed inner fit loop, shared by MultiLayerNetwork.fit,
     ComputationGraph.fit and ParallelTrainer.fit.
@@ -143,22 +386,34 @@ class SuperstepRunner:
       dispatch(staged, n, step0)
                        run the jitted superstep, rebinding the model's
                        params/state/updater/RNG in place; returns the
-                       device [K] loss vector WITHOUT syncing it
+                       device loss vector(s) WITHOUT syncing: a [n] array
+                       for grad_accumulation=1, else a list of
+                       (scores[K], micro_scores[K, M]) group parts (see
+                       split_accum_groups)
       on_window_end(window)
                        per-window bookkeeping (last_input/last_batch_size,
                        signature tracking, telemetry samples) — runs only
                        for KEPT windows, before the listener replay
 
+    With ``grad_accumulation=M`` the window holds K·M MICROBATCHES (K
+    optimizer steps); listeners/guard/counters operate per optimizer step
+    while the checkpoint batch cursor keeps counting iterator
+    microbatches, so window edges are always optimizer-step boundaries.
+
     One runner drives one fit() call; `skip()` positions the resume
     cursor before the first epoch.
     """
 
-    def __init__(self, model, adapter, superstep, *, guard=None, ckpt=None):
+    def __init__(self, model, adapter, superstep, *, guard=None, ckpt=None,
+                 grad_accumulation: int = 1):
         self.model = model
         self.adapter = adapter
         self.superstep = validate_superstep(superstep)
         self.guard = guard
         self.ckpt = ckpt
+        self._m = validate_grad_accumulation(grad_accumulation)
+        self._skip_nonfinite = accum_skip_nonfinite(guard, self._m)
+        self._autok: Optional[OverlapAutoK] = None
         self._k: Optional[int] = (self.superstep
                                   if isinstance(self.superstep, int) else None)
         self._skip = 0
@@ -183,13 +438,44 @@ class SuperstepRunner:
         if self._k is not None:
             return
         if self.superstep == "auto":
-            self._k = auto_superstep_k(self.adapter.batch_nbytes(ds))
-            log.info("superstep='auto' resolved to K=%d (batch ~%.2f MB, "
-                     "window budget %d MB)", self._k,
-                     self.adapter.batch_nbytes(ds) / 1e6,
-                     AUTO_WINDOW_BYTES >> 20)
+            # byte budget SEEDS K (staged window = K·M microbatches);
+            # from there OverlapAutoK grows it from the measured
+            # dispatch/compute ratio (ISSUE 12). Growth is bounded BOTH
+            # by the step cap and by a byte ceiling: the grown window may
+            # trade staging memory for dispatch amortization only up to
+            # AUTO_ADAPT_WINDOW_BYTES, so large batches can't double
+            # themselves into staging OOM
+            nbytes = self.adapter.batch_nbytes(ds)
+            micros = auto_superstep_k(nbytes)
+            self._k = max(1, micros // self._m)
+            byte_cap = max(self._k, int(
+                AUTO_ADAPT_WINDOW_BYTES // max(1, nbytes * self._m)))
+            self._autok = OverlapAutoK(
+                self._k, max_k=min(AUTO_ADAPT_MAX_K, byte_cap))
+            log.info("superstep='auto' seeded at K=%d optimizer steps "
+                     "(batch ~%.2f MB x M=%d, window budget %d MB, "
+                     "adaptive cap K<=%d); overlap-aware adaptation "
+                     "active", self._k, nbytes / 1e6, self._m,
+                     AUTO_WINDOW_BYTES >> 20, self._autok.max_k)
         else:   # EPOCH: bounded only by the epoch / signature changes
             self._k = 1 << 30
+
+    def _steps_in(self, n_micro: int) -> int:
+        return steps_in(n_micro, self._m)
+
+    def _observe_auto(self, window, dispatch_s: float, period_s: float):
+        """Feed a FULL window's measured timings to the overlap-aware
+        auto-K policy (partial tail windows would understate the ratio)."""
+        if self._autok is None or len(window) != self._k * self._m:
+            return
+        new_k = self._autok.observe(dispatch_s, period_s)
+        if new_k != self._k:
+            log.info(
+                "superstep='auto' growing K %d -> %d (measured dispatch "
+                "share %.1f%% of window period, target %.0f%%)", self._k,
+                new_k, 100.0 * self._autok._disp / self._autok._period,
+                100.0 * self._autok.target_share)
+            self._k = new_k
 
     def _collect(self, data):
         """Next window: up to K consecutive batches sharing one signature.
@@ -226,7 +512,7 @@ class SuperstepRunner:
                 self._pending = ds
                 break
             window.append(ds)
-            if len(window) >= self._k:
+            if len(window) >= self._k * self._m:
                 break
         return window
 
@@ -270,16 +556,20 @@ class SuperstepRunner:
         guard verdict applied, checkpoint cursor advanced) before the next
         window is dispatched — a rollback can never race a dispatch."""
         while True:
+            t_win = time.perf_counter()
             with span("host/batch_prep", kind="superstep_window"):
                 window = self._collect(data)
                 staged = self._stage(window)
             if not window:
                 return
             snap = self._pre_window_snapshot()
+            t_d = time.perf_counter()
             with span("device/dispatch", kind="superstep"):
                 scores = self.adapter.dispatch(staged, len(window),
                                                self.model.iteration_count)
+            t_d = time.perf_counter() - t_d
             self._finalize(window, scores, snap, span)
+            self._observe_auto(window, t_d, time.perf_counter() - t_win)
 
     def _run_pipelined(self, data, span):
         """No guard, no checkpointer: window i+1 is collected, stacked and
@@ -299,10 +589,13 @@ class SuperstepRunner:
         with span("host/batch_prep", kind="superstep_window"):
             window = self._collect(data)
             staged = self._stage(window)
+        t_prev = time.perf_counter()
         while window:
+            t_d = time.perf_counter()
             with span("device/dispatch", kind="superstep"):
                 scores = self.adapter.dispatch(staged, len(window), step0)
-            step0 += len(window)
+            t_d = time.perf_counter() - t_d
+            step0 += self._steps_in(len(window))
             cur = (window, scores)
             with span("host/batch_prep", kind="superstep_window"):
                 window = self._collect(data)
@@ -313,6 +606,9 @@ class SuperstepRunner:
                 inflight = cur
             else:
                 self._finalize(cur[0], cur[1], None, span)
+            now = time.perf_counter()
+            self._observe_auto(cur[0], t_d, now - t_prev)
+            t_prev = now
         if inflight is not None:
             self._finalize(inflight[0], inflight[1], None, span)
 
@@ -327,16 +623,35 @@ class SuperstepRunner:
 
     def _finalize(self, window, scores_dev, snap, span):
         model = self.model
-        n = len(window)
+        n_micro = len(window)
         with span("device/sync", kind="superstep_scores"):
-            host_scores = np.asarray(scores_dev)
+            if self._m == 1:
+                host_scores = np.asarray(scores_dev)
+                micro_scores = None
+            else:
+                # dispatch returned accumulation-group parts: per-step
+                # scores concatenate in step order; raw per-microbatch
+                # scores kept for skip accounting
+                host_scores = np.concatenate(
+                    [np.asarray(s).reshape(-1) for s, _ in scores_dev])
+                micro_scores = [np.asarray(ms) for _, ms in scores_dev]
+        n_steps = len(host_scores)
         kept = True
         if self.guard is not None:
             # superstep-granular guard: a bad window is discarded WHOLE,
             # restoring the pre-superstep snapshot (params/updater/RNG/
             # counters) — fit_scan's epoch-granular contract at window
-            # granularity
+            # granularity. Under skip_nonfinite the accumulated step
+            # already neutralized bad MICROBATCHES in-trace (finite step
+            # score), so only fully-poisoned steps reach this policy.
             kept = self.guard.check_scores(model, host_scores, snap)
+            if kept and micro_scores is not None and self._skip_nonfinite:
+                bad = int(sum((~np.isfinite(ms)).sum()
+                              for ms in micro_scores))
+                if bad:
+                    note = getattr(self.guard, "note_skipped_micros", None)
+                    if note is not None:
+                        note(model, bad)
         if kept:
             self.adapter.on_window_end(window)
             listeners = getattr(model, "listeners", None) or []
@@ -344,20 +659,25 @@ class SuperstepRunner:
                 # replay at the superstep edge with the ALREADY-TRANSFERRED
                 # loss vector: every iteration_done sees a HOST scalar, so
                 # listeners reading model.score() re-sync nothing
-                # (graftlint hot-loop-sync stays structurally quiet here)
-                for i in range(n):
+                # (graftlint hot-loop-sync stays structurally quiet here).
+                # Cadence contract: one iteration_done per OPTIMIZER step —
+                # microbatches are not iterations
+                for i in range(n_steps):
                     model._score = host_scores[i]
                     model.iteration_count += 1
                     for listener in listeners:
                         listener.iteration_done(model, model.iteration_count)
             else:
                 model._score = host_scores[-1]
-                model.iteration_count += n
+                model.iteration_count += n_steps
         if self.ckpt is not None:
             # cursor advances for kept AND discarded windows (the batches
             # were consumed either way — per-batch fit does the same),
             # plus any untrainable batches consumed during collection —
             # counted HERE, at the edge, so the cursor never runs ahead
-            # of the trained state
-            self.ckpt.on_batches(n + self._untrained)
+            # of the trained state. The cursor counts MICROBATCHES (what
+            # the iterator yields and what resume re-draws); edges are
+            # optimizer-step boundaries by construction, so a saved
+            # cursor never lands mid-accumulation
+            self.ckpt.on_batches(n_micro + self._untrained)
             self._untrained = 0

@@ -19,6 +19,18 @@ different lr per layer subtree (reference: per-layer `learningRateByParam`).
 Updater state is itself a pytree, so checkpointing (`updaterState.bin`
 equivalent) and cross-replica averaging (`ParallelWrapper.averageUpdatersState`,
 `ParallelWrapper.java:239`) fall out for free.
+
+Gradient-accumulation contract (nn/superstep.py, parallel/zero.py): under
+`fit(grad_accumulation=M)` an updater's `update` is called once per
+OPTIMIZER step with the fp32-accumulated MEAN of the M microbatch
+gradients, and `step` counts optimizer steps — so bias correction
+(Adam/AdaMax `t`), momentum EMAs and lr schedules all advance per
+effective M·b batch, never per microbatch. Nothing in an updater needs to
+know M; an updater whose math depended on the raw per-microbatch
+gradients (gradient-noise estimators, say) would need the accumulation
+loop's hooks instead. The ZeRO sharding contract below
+(`elementwise_state`) is unchanged: the mean of shards equals the shard
+of the mean, so sharded accumulation composes with every built-in.
 """
 from __future__ import annotations
 

@@ -21,6 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..telemetry.compile_watch import watch_compiles
+
 __all__ = ["BackTrackLineSearch", "LineSearchSolver",
            "GraphLineSearchSolver"]
 
@@ -111,7 +113,7 @@ class LineSearchSolver:
                 self.model._loss_fn, has_aux=True)(
                     params, state, x, y, rng, fmask=fmask, lmask=lmask)
             return sign * f, new_state, _scale(sign, g)
-        return jax.jit(vag)
+        return watch_compiles(jax.jit(vag), "optimize/line_vag")
 
     @functools.cached_property
     def _loss_at(self):
@@ -122,7 +124,7 @@ class LineSearchSolver:
             f, _ = self.model._loss_fn(p, state, x, y, rng, fmask=fmask,
                                        lmask=lmask)
             return sign * f
-        return jax.jit(loss_at)
+        return watch_compiles(jax.jit(loss_at), "optimize/line_loss_at")
 
     # -- directions ------------------------------------------------------
     def _direction(self, g):
@@ -217,7 +219,7 @@ class GraphLineSearchSolver(LineSearchSolver):
                     params, state, inputs, labels, rng, fmasks=fmasks,
                     lmasks=lmasks)
             return sign * f, new_state, _scale(sign, g)
-        return jax.jit(vag)
+        return watch_compiles(jax.jit(vag), "optimize/graph_line_vag")
 
     @functools.cached_property
     def _loss_at(self):
@@ -229,4 +231,4 @@ class GraphLineSearchSolver(LineSearchSolver):
             f, _ = self.model._loss_fn(p, state, inputs, labels, rng,
                                        fmasks=fmasks, lmasks=lmasks)
             return sign * f
-        return jax.jit(loss_at)
+        return watch_compiles(jax.jit(loss_at), "optimize/graph_line_loss_at")

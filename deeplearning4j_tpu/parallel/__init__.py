@@ -1,7 +1,8 @@
 from .mesh import MeshAxes, make_hybrid_mesh, make_mesh
 from .sharding import ShardingStrategy, param_specs, shard_model
 from .trainer import ParallelTrainer, ParallelWrapper, TrainingMode
-from .zero import (ZeroConfig, assign_buckets, make_zero_step,
+from .zero import (ZeroConfig, assign_buckets, collective_overlap_fraction,
+                   make_zero_accum_superstep, make_zero_step,
                    zero_grad_specs, zero_opt_shardings)
 from .ring_attention import (blockwise_attention, local_attention_reference,
                              ring_attention_sharded, ring_self_attention)
@@ -23,6 +24,7 @@ __all__ = [
     "global_mesh", "initialize", "is_multi_host", "local_batch_slice",
     "process_index",
     "ShardedCheckpoint", "restore_sharded", "save_sharded",
-    "ZeroConfig", "assign_buckets", "make_zero_step", "zero_grad_specs",
+    "ZeroConfig", "assign_buckets", "collective_overlap_fraction",
+    "make_zero_accum_superstep", "make_zero_step", "zero_grad_specs",
     "zero_opt_shardings",
 ]

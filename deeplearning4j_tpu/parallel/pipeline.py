@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.iterators import DataSet
+from ..telemetry.compile_watch import watch_compiles
 
 __all__ = ["pipeline_forward", "PipelinedDenseStack",
            "PipelinedNetworkTrainer", "PipelinedGraphTrainer"]
@@ -127,7 +128,8 @@ class PipelinedDenseStack:
 
         stage_sh = NamedSharding(self.mesh, P(self.axis))
         params = jax.device_put(params, stage_sh)
-        out = jax.jit(wrapper)(params, xm)
+        out = watch_compiles(jax.jit(wrapper),
+                             "pipeline/spmd_forward")(params, xm)
         return out.reshape(B, self.features)
 
 
@@ -277,7 +279,8 @@ class PipelinedNetworkTrainer:
 
     @functools.cached_property
     def _stage_fwd_jits(self):
-        return [jax.jit(self._stage_forward(s))
+        return [watch_compiles(jax.jit(self._stage_forward(s)),
+                               "pipeline/stage_fwd")
                 for s in range(self.n_stages)]
 
     @functools.cached_property
@@ -295,7 +298,9 @@ class PipelinedNetworkTrainer:
                 gp, gx = vjp((cot, jax.tree_util.tree_map(jnp.zeros_like,
                                                           new_state)))
                 return gp, gx, new_state
-            jits.append(jax.jit(bwd))  # one jit per stage, built once  # graftlint: disable=jit-in-loop
+            # one jit per stage, built once
+            jits.append(watch_compiles(jax.jit(bwd),  # graftlint: disable=jit-in-loop
+                                       "pipeline/stage_bwd"))
         return jits
 
     @functools.cached_property
@@ -327,7 +332,8 @@ class PipelinedNetworkTrainer:
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state
 
-        return jax.jit(grad_fn)
+        return watch_compiles(jax.jit(grad_fn),
+                              "pipeline/last_stage_grad")
 
     @functools.cached_property
     def _stage_reg_grads(self):
@@ -343,7 +349,9 @@ class PipelinedNetworkTrainer:
                     if p:
                         total = total + layer.reg_score(p)
                 return total
-            jits.append(jax.jit(jax.value_and_grad(reg)))  # graftlint: disable=jit-in-loop
+            jits.append(watch_compiles(
+                jax.jit(jax.value_and_grad(reg)),  # graftlint: disable=jit-in-loop
+                "pipeline/stage_reg"))
         return jits
 
     @functools.cached_property
@@ -361,7 +369,8 @@ class PipelinedNetworkTrainer:
                 p, o = self.model.apply_layer_updates(
                     _layers, params, grads, opt, step)
                 return tuple(p), tuple(o)
-            jits.append(jax.jit(upd))  # per-stage, cached  # graftlint: disable=jit-in-loop
+            jits.append(watch_compiles(
+                jax.jit(upd), "pipeline/stage_update"))  # per-stage, cached  # graftlint: disable=jit-in-loop
         return jits
 
     # -- training --------------------------------------------------------
@@ -674,7 +683,8 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state
 
-        return jax.jit(grad_fn)
+        return watch_compiles(jax.jit(grad_fn),
+                              "pipeline/graph_last_stage_grad")
 
     @functools.cached_property
     def _stage_reg_grads(self):
@@ -690,7 +700,9 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     if p:
                         total = total + conf.vertices[n].reg_score(p)
                 return total
-            jits.append(jax.jit(jax.value_and_grad(reg)))  # graftlint: disable=jit-in-loop
+            jits.append(watch_compiles(
+                jax.jit(jax.value_and_grad(reg)),  # graftlint: disable=jit-in-loop
+                "pipeline/graph_stage_reg"))
         return jits
 
     @functools.cached_property
@@ -738,7 +750,8 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     new_p[n] = jax.tree_util.tree_map(
                         lambda a, u_: a - u_, p, updates)
                 return new_p, new_o
-            jits.append(jax.jit(upd))  # per-stage, cached  # graftlint: disable=jit-in-loop
+            jits.append(watch_compiles(
+                jax.jit(upd), "pipeline/graph_stage_update"))  # per-stage, cached  # graftlint: disable=jit-in-loop
         return jits
 
     def sync_back(self):

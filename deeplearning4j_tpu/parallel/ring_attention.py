@@ -156,4 +156,5 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "seq",
     q = jax.device_put(q, sh)
     k = jax.device_put(k, sh)
     v = jax.device_put(v, sh)
-    return jax.jit(fn)(q, k, v)
+    from ..telemetry.compile_watch import watch_compiles
+    return watch_compiles(jax.jit(fn), "parallel/ring_attention")(q, k, v)

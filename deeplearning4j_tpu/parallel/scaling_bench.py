@@ -97,7 +97,8 @@ def _bench_data(model: str, global_batch: int, image: int):
 
 
 def _make_trainer(n_devices: int, model: str, updater: str, image: int,
-                  hidden: int, strategy: str = "replicated"):
+                  hidden: int, strategy: str = "replicated",
+                  collect_stats: bool = True):
     import jax
 
     from .mesh import make_mesh
@@ -107,7 +108,7 @@ def _make_trainer(n_devices: int, model: str, updater: str, image: int,
     mesh = make_mesh({"data": n_devices},
                      devices=jax.devices()[:n_devices])
     return ParallelTrainer(net, mesh=mesh, mode=TrainingMode.SYNC,
-                           strategy=strategy, collect_stats=True)
+                           strategy=strategy, collect_stats=collect_stats)
 
 
 def _window(trainer, ds, steps: int):
@@ -187,6 +188,96 @@ def measure_paired_zero(n_devices: int, global_batch: int = 64,
     return out
 
 
+def measure_paired_accum(n_devices: int, micro_batch: int = 32, m: int = 8,
+                         steps: int = 2, warmup: int = 1, hidden: int = 1024,
+                         model: str = "mlp", image: int = 32, reps: int = 3,
+                         strategy: str = "zero2"):
+    """Gradient-accumulation ablation (ISSUE 12): effective batch M·b via
+    M microbatch accumulation vs the NATIVE M·b batch, in ALTERNATING
+    measured windows on the same devices (load drift hits both arms
+    equally). Every optimizer step consumes the same M·b samples, so the
+    per-step wall-time ratio native/accum IS the effective-batch
+    throughput ratio — the acceptance number ISSUE 12 gates at >= 0.9
+    ("within 10% of native") on the 8-dev virtual mesh. Also reports the
+    static fp32 accumulator footprint (ZERO2 sharded vs replicated —
+    the ~1/N memory story) and the structural collective/compute overlap
+    fraction of the accumulated schedule.
+
+    Virtual-mesh caveat (same class as the ZeRO efficiency gate): the
+    single-process CPU mesh SERIALIZES collectives, so the per-microbatch
+    reduce-scatter traffic that overlaps backward on real ICI is paid
+    inline here — the measured ratio is a LOWER bound for hardware. The
+    default hidden=1024 keeps each b32 microbatch compute-dense enough to
+    be representative; at toy widths (hidden<=512 on a 2-core host) the
+    per-microbatch dispatch floor dominates and the ratio collapses —
+    that regime is exactly what composing superstep>1 with accumulation
+    exists for."""
+    import numpy as np
+
+    from .zero import collective_overlap_fraction
+    from ..datasets.iterators import DataSet, ListDataSetIterator
+
+    accum = _make_trainer(n_devices, model, "adam", image, hidden,
+                          strategy, collect_stats=False)
+    native = _make_trainer(n_devices, model, "adam", image, hidden,
+                           strategy, collect_stats=False)
+    big = _bench_data(model, micro_batch * m, image)
+    xs, ys = np.asarray(big.features), np.asarray(big.labels)
+    micros = [DataSet(xs[i * micro_batch:(i + 1) * micro_batch],
+                      ys[i * micro_batch:(i + 1) * micro_batch])
+              for i in range(m)]
+
+    def accum_window(n_steps):
+        it = ListDataSetIterator(list(micros) * n_steps)
+        t0 = time.perf_counter()
+        accum.fit(it, grad_accumulation=m)
+        float(accum.score())
+        return (time.perf_counter() - t0) / n_steps
+
+    def native_window(n_steps):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            native.fit(big)
+        float(native.score())
+        return (time.perf_counter() - t0) / n_steps
+
+    accum_window(warmup)    # pays the accum-superstep compile
+    native_window(warmup)   # pays the per-batch step compile
+    rep = {"accum": [], "native": []}
+    for _ in range(max(1, int(reps))):
+        rep["accum"].append(round(accum_window(steps) * 1e3, 2))
+        rep["native"].append(round(native_window(steps) * 1e3, 2))
+    t_acc = _median(rep["accum"])
+    t_nat = _median(rep["native"])
+    # paired per-round ratios (drift cancels within a round)
+    ratios = sorted(n_ / a_ for a_, n_ in zip(rep["accum"], rep["native"]))
+    info = accum._zero_info or {}
+    acc_bytes = info.get("accum_bytes", {})
+    out = {"mode": "accum", "strategy": strategy, "devices": n_devices,
+           "micro_batch": micro_batch, "m": m,
+           "effective_batch": micro_batch * m,
+           "t_accum_step_ms": round(t_acc, 2),
+           "t_native_step_ms": round(t_nat, 2),
+           "rep_ms": rep,
+           "throughput_ratio": round(t_nat / t_acc, 3),
+           "throughput_ratio_paired": round(ratios[len(ratios) // 2], 3),
+           "throughput_ratio_spread": [round(ratios[0], 3),
+                                       round(ratios[-1], 3)],
+           "overlap_fraction": collective_overlap_fraction(info, m),
+           "accumulator_bytes": {
+               "sharded_per_device": acc_bytes.get("sharded"),
+               "replicated_per_device": acc_bytes.get("replicated"),
+               "ratio": (round(acc_bytes["sharded"]
+                               / acc_bytes["replicated"], 4)
+                         if acc_bytes.get("replicated") else None)},
+           "gate": {"metric": f"accum-effective-b{micro_batch * m}-"
+                              f"{n_devices}dev",
+                    "value": round(ratios[len(ratios) // 2], 3),
+                    "target": 0.9,
+                    "ok": ratios[len(ratios) // 2] >= 0.9}}
+    return out
+
+
 def _median(xs):
     return sorted(xs)[len(xs) // 2]
 
@@ -255,11 +346,13 @@ def measure_pipeline(s_stages: int = 4, microbatches=(1, 2, 4, 8),
     from .pipeline import pipeline_forward as _pf
 
     stack = PipelinedDenseStack(features, s_stages, mesh)
-    fn = jax.jit(_shard_map(
+    from ..telemetry.compile_watch import watch_compiles
+
+    fn = watch_compiles(jax.jit(_shard_map(
         _ft.partial(_pf, stack._stage_fn, axis_name="pipe",
                     n_stages=s_stages),
         mesh=mesh, in_specs=(_P("pipe"), _P()), out_specs=_P(),
-        check_vma=False))
+        check_vma=False)), "bench/pipeline_tick")
     params_sh = jax.device_put(stack.params, _NS(mesh, _P("pipe")))
     med_t = {}
     for m in microbatches:
@@ -366,17 +459,42 @@ def main(argv=None):
     ap.add_argument("--global-batch", type=int, default=64)
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--reps", type=int, default=1)
-    ap.add_argument("--model", choices=("vgg16", "mlp"), default="vgg16")
+    ap.add_argument("--model", choices=("vgg16", "mlp"), default=None)
+    # dp mode benches the declared VGG16 config; accum mode defaults to
+    # the compute-dense MLP — VGG16 convs inside the accumulation scan
+    # take minutes of XLA:CPU compile + the documented conv-in-scan
+    # slowdown, which would measure the artifact, not the schedule
     ap.add_argument("--image", type=int, default=32)
     ap.add_argument("--no-ablation", action="store_true")
     ap.add_argument("--no-zero", action="store_true",
                     help="skip the paired replicated-vs-ZeRO ablation")
-    ap.add_argument("--zero-stage", type=int, choices=(1, 2), default=1)
-    ap.add_argument("--mode", choices=("dp", "pipeline"), default="dp")
+    ap.add_argument("--zero-stage", type=int, choices=(1, 2),
+                default=None)  # dp mode: 1; accum mode: 2
+    ap.add_argument("--mode", choices=("dp", "pipeline", "accum"),
+                    default="dp")
+    ap.add_argument("--micro-batch", type=int, default=32)
+    ap.add_argument("--accum-m", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=None,
+                    help="mlp hidden width override (accum mode; default "
+                         "1024 — compute-dense enough to be representative)")
     a = ap.parse_args(argv)
     _provision(a.devices)
     from ..telemetry import runtime as telemetry_runtime
     sess = telemetry_runtime.enable()
+    if a.mode == "accum":
+        # accumulation defaults to ZERO2 — the stage whose sharded
+        # accumulators the ablation exists to measure
+        stage = a.zero_stage if a.zero_stage is not None else 2
+        kw = {} if a.hidden is None else {"hidden": a.hidden}
+        out = measure_paired_accum(
+            a.devices, micro_batch=a.micro_batch, m=a.accum_m,
+            steps=a.steps, reps=max(2, a.reps), model=a.model or "mlp",
+            image=a.image,
+            strategy="replicated" if a.no_zero else f"zero{stage}", **kw)
+        sess.watermarks.sample()
+        out["telemetry"] = _telemetry_fields(sess)
+        print(json.dumps(out))
+        return
     if a.mode == "pipeline":
         out = measure_pipeline(
             s_stages=min(4, a.devices), global_batch=a.global_batch,
@@ -385,15 +503,16 @@ def main(argv=None):
         out["telemetry"] = _telemetry_fields(sess)
         print(json.dumps(out))
         return
-    m1 = measure(1, a.global_batch, a.steps, model=a.model,
+    model = a.model or "vgg16"
+    m1 = measure(1, a.global_batch, a.steps, model=model,
                  image=a.image, reps=a.reps)
-    mn = measure(a.devices, a.global_batch, a.steps, model=a.model,
+    mn = measure(a.devices, a.global_batch, a.steps, model=model,
                  image=a.image, reps=a.reps)
     t1, tn = m1["median_ms"], mn["median_ms"]
     # conservative efficiency bounds from the rep spreads
     eff_lo = min(m1["rep_ms"]) / max(mn["rep_ms"])
     eff_hi = max(m1["rep_ms"]) / min(mn["rep_ms"])
-    out = {"model": a.model, "t1_ms": round(t1, 2), "tn_ms": round(tn, 2),
+    out = {"model": model, "t1_ms": round(t1, 2), "tn_ms": round(tn, 2),
            "t1_rep_ms": m1["rep_ms"], "tn_rep_ms": mn["rep_ms"],
            "devices": a.devices, "efficiency": round(t1 / tn, 3),
            "efficiency_spread": [round(eff_lo, 3), round(eff_hi, 3)],
@@ -404,9 +523,9 @@ def main(argv=None):
         # update runs once per device on shared cores. Adam-vs-SGD step
         # delta at n devices minus the same delta at 1 device == measured
         # cost of the replication.
-        m1s = measure(1, a.global_batch, a.steps, model=a.model,
+        m1s = measure(1, a.global_batch, a.steps, model=model,
                       image=a.image, updater="sgd", reps=a.reps)
-        mns = measure(a.devices, a.global_batch, a.steps, model=a.model,
+        mns = measure(a.devices, a.global_batch, a.steps, model=model,
                       image=a.image, updater="sgd", reps=a.reps)
         t1s, tns = m1s["median_ms"], mns["median_ms"]
         out["updater_ablation"] = {
@@ -426,9 +545,9 @@ def main(argv=None):
         # cores — exactly the artifact the sharded update removes — so
         # efficiency_zero = t1/tn_zero is the headline the ≥0.85 target
         # gates on
-        strategy = f"zero{a.zero_stage}"
+        strategy = f"zero{a.zero_stage or 1}"
         pz = measure_paired_zero(a.devices, a.global_batch, a.steps,
-                                 model=a.model, image=a.image,
+                                 model=model, image=a.image,
                                  reps=max(2, a.reps), strategy=strategy)
         tz = pz[strategy]["median_ms"]
         tr_ = pz["replicated"]["median_ms"]

@@ -336,14 +336,15 @@ class ParallelTrainer:
         self._host_cache = None
         self._eval_cache = None
         # a restore re-prepares with a fresh raw step closure; drop the
-        # cached superstep jit so it can't capture the stale one
+        # cached superstep jits so they can't capture the stale one
         self.__dict__.pop("_superstep_jit", None)
+        self.__dict__.pop("_accum_superstep_cache", None)
         self._rng = m._rng if getattr(m, "_rng", None) is not None else \
             jax.random.PRNGKey(0)
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1, *, superstep=1,
-            prefetch: bool = False,
+            grad_accumulation: int = 1, prefetch: bool = False,
             pad_ragged: bool = False, time_buckets=None,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
             resume: bool = False, guard=None):
@@ -366,6 +367,22 @@ class ParallelTrainer:
         AVERAGING/PIPELINE, multi-process meshes, and `collect_stats`
         (whose phase timers are per-batch by contract).
 
+        `grad_accumulation=M` accumulates M consecutive iterator
+        microbatches into one optimizer step for every SYNC strategy
+        (effective global batch M·b at b's activation memory; one
+        iteration/listener event and one lr-schedule step per OPTIMIZER
+        step). Under ZERO2 each microbatch's gradient buckets are
+        reduce-scattered as backward produces them and summed into the
+        SHARDED fp32 accumulator (~1/N accumulator memory per device),
+        with the bucket-ordering barrier token threaded across microbatch
+        boundaries so collective traffic can overlap the next
+        microbatch's backward; params allgather once per optimizer step.
+        The structural overlap lands in the
+        `dl4j_collective_overlap_fraction` gauge. Configurations that
+        train per batch (AVERAGING/PIPELINE, multi-process meshes,
+        collect_stats) REJECT M>1 — silently training a different
+        effective batch would be worse than an error.
+
         Fault-tolerance knobs mirror `MultiLayerNetwork.fit`, backed by
         the **sharded** store (`parallel/checkpoint.py`): step dirs with
         COMMIT markers, resume restores params/updater/counters/trainer
@@ -374,6 +391,8 @@ class ParallelTrainer:
         every replica (per-replica local-SGD divergence inside the current
         averaging window is not persisted). `guard` applies its
         non-finite-loss policy to the mesh-wide step score."""
+        from ..nn.superstep import validate_grad_accumulation
+        accum_m = validate_grad_accumulation(grad_accumulation)
         if self._pipe is not None:
             if checkpoint_dir is not None or resume or guard is not None:
                 raise ValueError(
@@ -381,6 +400,12 @@ class ParallelTrainer:
                     "PIPELINE strategy (stage-partitioned params live in "
                     "the pipe trainer); checkpoint the wrapped model via "
                     "ModelSerializer after fit instead")
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} is not supported for "
+                    "the PIPELINE strategy (its GPipe schedule already "
+                    "microbatches; use n_microbatches on the pipe "
+                    "trainer)")
             self._pipe.fit(data, epochs=epochs)
             self.iteration_count = self._pipe.iteration_count
             self._pipe.sync_back()
@@ -390,6 +415,10 @@ class ParallelTrainer:
                 raise ValueError(
                     "checkpoint_dir/resume need an iterator fit (the "
                     "checkpoint records epoch/batch progress)")
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} needs an iterator fit "
+                    "(M consecutive microbatches form one optimizer step)")
             if superstep != 1:
                 import logging
                 logging.getLogger("deeplearning4j_tpu").info(
@@ -403,14 +432,16 @@ class ParallelTrainer:
             self._sync_back()
             return self
         from ..fault.resume import sharded_fit_checkpointer
-        ckpt = sharded_fit_checkpointer(self, checkpoint_dir,
-                                        checkpoint_every, resume)
+        ckpt = sharded_fit_checkpointer(
+            self, checkpoint_dir, checkpoint_every, resume,
+            context={"grad_accumulation": accum_m})
         skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
                                      prefetch=prefetch,
                                      time_buckets=time_buckets)
-        runner = self._make_superstep_runner(superstep, guard, ckpt)
+        runner = self._make_superstep_runner(superstep, guard, ckpt, accum_m)
+        self._set_overlap_gauge(accum_m)
         if runner is not None:
             runner.skip(skip)
             skip = 0
@@ -445,14 +476,17 @@ class ParallelTrainer:
         self._sync_back()
         return self
 
-    def _make_superstep_runner(self, superstep, guard, ckpt):
+    def _make_superstep_runner(self, superstep, guard, ckpt, accum_m=1):
         """SuperstepRunner composing the window scan with the sharded SYNC
-        step, or None for per-batch dispatch (superstep=1, AVERAGING,
-        PIPELINE, multi-process, collect_stats)."""
-        from ..nn.superstep import SuperstepRunner, validate_superstep
+        step, or None for per-batch dispatch (superstep=1 with
+        grad_accumulation=1, AVERAGING, PIPELINE, multi-process,
+        collect_stats — the latter configurations REJECT accumulation
+        instead of silently changing the effective batch)."""
+        from ..nn.superstep import (SuperstepRunner, accum_skip_nonfinite,
+                                    validate_superstep)
 
         k = validate_superstep(superstep)
-        if k == 1:
+        if k == 1 and accum_m == 1:
             return None
         reason = None
         if getattr(self, "_raw_step_fn", None) is None:
@@ -464,13 +498,20 @@ class ParallelTrainer:
         elif self.stats is not None:
             reason = "collect_stats times phases per batch by contract"
         if reason is not None:
+            if accum_m != 1:
+                raise ValueError(
+                    f"grad_accumulation={accum_m} is not supported here: "
+                    f"{reason}")
             import logging
             logging.getLogger("deeplearning4j_tpu").info(
                 "superstep=%r falls back to per-batch dispatch: %s",
                 superstep, reason)
             return None
-        return SuperstepRunner(self, _TrainerSuperstepAdapter(self), k,
-                               guard=guard, ckpt=ckpt)
+        adapter = _TrainerSuperstepAdapter(
+            self, m=accum_m,
+            skip_nonfinite=accum_skip_nonfinite(guard, accum_m))
+        return SuperstepRunner(self, adapter, k, guard=guard, ckpt=ckpt,
+                               grad_accumulation=accum_m)
 
     @functools.cached_property
     def _superstep_jit(self):
@@ -489,6 +530,65 @@ class ParallelTrainer:
                           win, win, win, win),
             out_shardings=(self._p_sh, repl, self._o_sh, repl, repl),
             donate_argnums=(0, 1, 2)), "parallel/superstep")
+
+    def _accum_superstep_jit(self, skip_nonfinite: bool):
+        """Jitted ACCUMULATED superstep for the SYNC strategies: nested
+        scan over [K, M, batch, ...] windows with the training shardings
+        carried through (window batch axis 2 sharded over `data`). The
+        ZeRO strategies route through `make_zero_accum_superstep` — the
+        sharded-accumulator, token-chained reduce-scatter variant — while
+        REPLICATED/TP/FSDP compose the generic builder with the model's
+        grad/update split. Cached per skip flag; K and M are
+        shape-derived (one XLA compile per distinct grouping)."""
+        cache = self.__dict__.setdefault("_accum_superstep_cache", {})
+        fn = cache.get(bool(skip_nonfinite))
+        if fn is not None:
+            return fn
+        if self.strategy in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2):
+            from .zero import (DEFAULT_BUCKET_MB, ZeroConfig,
+                               make_zero_accum_superstep)
+            cfg = ZeroConfig(
+                stage=1 if self.strategy == ShardingStrategy.ZERO1 else 2,
+                bucket_mb=(DEFAULT_BUCKET_MB if self.zero_bucket_mb is None
+                           else self.zero_bucket_mb),
+                reduce_dtype=self.zero_reduce_dtype)
+            raw, _info = make_zero_accum_superstep(
+                self.model, self.mesh, data_axis=self.data_axis,
+                config=cfg, skip_nonfinite=bool(skip_nonfinite))
+            name = "parallel/zero_accum_superstep"
+        else:
+            from ..nn.superstep import build_accum_superstep
+            raw = build_accum_superstep(self.model.grad_step_fn,
+                                        self.model.apply_updates,
+                                        bool(skip_nonfinite))
+            name = "parallel/accum_superstep"
+        win = NamedSharding(self.mesh, P(None, None, self.data_axis))
+        repl = self._repl
+        fn = watch_compiles(jax.jit(
+            raw,
+            in_shardings=(self._p_sh, repl, self._o_sh, repl, repl,
+                          win, win, win, win),
+            out_shardings=(self._p_sh, repl, self._o_sh, repl, repl, repl),
+            donate_argnums=(0, 1, 2)), name)
+        cache[bool(skip_nonfinite)] = fn
+        return fn
+
+    def _set_overlap_gauge(self, accum_m: int):
+        """Publish the structural collective/compute overlap of this
+        fit's schedule (zero.collective_overlap_fraction) to the
+        `dl4j_collective_overlap_fraction` gauge — 1 - 1/(M·buckets) for
+        ZERO2's token-ordered bucket flushes, 0.0 for stage 1's deferred
+        reduction; no-op for non-ZeRO strategies or a disabled session."""
+        tel = _tel_active()
+        if tel is None or self._zero_info is None:
+            return
+        from .zero import collective_overlap_fraction
+        tel.registry.gauge(
+            "dl4j_collective_overlap_fraction",
+            "fraction of per-step reduce-scatter payload issued with "
+            "independent backward compute still in flight (structural, "
+            "from the schedule)").set(
+            collective_overlap_fraction(self._zero_info, accum_m))
 
     def _to_batch(self, ds):
         """(inputs, labels, fmasks, lmasks) pytrees: arrays for
@@ -596,15 +696,18 @@ class ParallelTrainer:
             # per-device watermarks over THIS trainer's mesh
             tel.watermarks.sample(devices=list(self.mesh.devices.flat))
 
-    def _record_zero_metrics(self, tel):
-        """Per-step ZeRO collective-traffic counters (static per-step
-        accounting from make_zero_step):
+    def _record_zero_metrics(self, tel, n_micro: int = 1, n_steps: int = 1):
+        """ZeRO collective-traffic counters (static accounting from
+        make_zero_step / make_zero_accum_superstep):
           dl4j_collective_bytes_total{op}   logical payload bytes by
                                             collective op
           dl4j_dp_bucket_flushes_total      gradient bucket reduce-scatter
                                             flushes (stage 2)
-        Counters are get-or-create against the active session's registry,
-        cached until the session changes."""
+        Under accumulation the reduce-scatter (and its bucket flushes)
+        runs once per MICROBATCH while the all-reduce/param-allgather run
+        once per OPTIMIZER step — hence the two multipliers. Counters are
+        get-or-create against the active session's registry, cached until
+        the session changes."""
         cached = getattr(self, "_zero_metrics", None)
         if cached is None or cached[0] is not tel:
             reg = tel.registry
@@ -620,9 +723,10 @@ class ParallelTrainer:
         info = self._zero_info
         for op, b in info["bytes"].items():
             if b:
-                c_bytes.inc(b, op=op)
-        if info["n_buckets"]:
-            c_flush.inc(info["n_buckets"])
+                mult = n_micro if op == "reduce_scatter" else n_steps
+                c_bytes.inc(b * mult, op=op)
+        if info["n_buckets"] and n_micro:
+            c_flush.inc(info["n_buckets"] * n_micro)
 
     @property
     def params_replicated(self) -> bool:
@@ -1067,10 +1171,15 @@ class _TrainerSuperstepAdapter:
     batches route through `_to_batch` (arrays for MultiLayerNetwork, dicts
     for ComputationGraph) and are trimmed to the data-axis multiple
     exactly as the per-batch step trims them; a batch that trims to zero
-    rows is consumed untrained (signature None), matching per-batch."""
+    rows is consumed untrained (signature None), matching per-batch. With
+    ``m>1`` dispatch routes the window through the accumulated superstep
+    (sharded accumulators under the ZeRO strategies) in [K, M] groups."""
 
-    def __init__(self, trainer: ParallelTrainer):
+    def __init__(self, trainer: ParallelTrainer, m: int = 1,
+                 skip_nonfinite: bool = False):
         self.trainer = trainer
+        self.m = int(m)
+        self.skip_nonfinite = bool(skip_nonfinite)
         self._memo = {}   # id(ds) -> trimmed batch (signature -> stage)
 
     def _trimmed(self, ds):
@@ -1115,37 +1224,41 @@ class _TrainerSuperstepAdapter:
 
     def dispatch(self, staged, n, step0):
         tr = self.trainer
-        xs, ys, fms, lms = staged
-        (tr._params, tr._state, tr._opt, tr._rng,
-         scores) = tr._superstep_jit(
-            tr._params, tr._state, tr._opt,
-            jnp.asarray(step0, jnp.int32), tr._rng, xs, ys, fms, lms)
-        return scores
+        if self.m == 1:
+            xs, ys, fms, lms = staged
+            (tr._params, tr._state, tr._opt, tr._rng,
+             scores) = tr._superstep_jit(
+                tr._params, tr._state, tr._opt,
+                jnp.asarray(step0, jnp.int32), tr._rng, xs, ys, fms, lms)
+            return scores
+        from ..nn.superstep import dispatch_accum_groups
+        fn = tr._accum_superstep_jit(self.skip_nonfinite)
+
+        def run_group(seg, step):
+            xs, ys, fms, lms = seg
+            (tr._params, tr._state, tr._opt, tr._rng, scores,
+             mscores) = fn(tr._params, tr._state, tr._opt,
+                           jnp.asarray(step, jnp.int32), tr._rng,
+                           xs, ys, fms, lms)
+            return scores, mscores
+
+        return dispatch_accum_groups(staged, n, self.m, step0, run_group)
 
     def on_window_end(self, window):
+        from ..nn.superstep import steps_in
+
         tr = self.trainer
         n = len(window)
+        n_steps = steps_in(n, self.m)
         tel = _tel_active()
         if tel is None:
             return
         if tr._zero_info is not None:
-            # static per-step accounting scales linearly over the window
-            cached = getattr(tr, "_zero_metrics", None)
-            if cached is None or cached[0] is not tel:
-                tr._record_zero_metrics(tel)   # creates + counts 1 step
-                remaining = n - 1
-            else:
-                remaining = n
-            if remaining:
-                _, c_bytes, c_flush = tr._zero_metrics
-                info = tr._zero_info
-                for op, b in info["bytes"].items():
-                    if b:
-                        c_bytes.inc(b * remaining, op=op)
-                if info["n_buckets"]:
-                    c_flush.inc(info["n_buckets"] * remaining)
+            # static accounting scales over the window: reduce-scatter per
+            # microbatch, all-reduce/allgather per optimizer step
+            tr._record_zero_metrics(tel, n_micro=n, n_steps=n_steps)
         w = tel.report_window
-        if (tr.iteration_count + n) // w > tr.iteration_count // w:
+        if (tr.iteration_count + n_steps) // w > tr.iteration_count // w:
             tel.watermarks.sample(devices=list(tr.mesh.devices.flat))
 
 

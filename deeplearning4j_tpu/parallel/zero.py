@@ -38,6 +38,16 @@ Both stages keep params replicated between steps, so evaluation, scoring,
 early stopping and checkpointing see an ordinary replicated model; only
 `updater_state` is mesh-sharded (orbax writes it shard-wise through
 `parallel/checkpoint.py`).
+
+Gradient accumulation (ISSUE 12, `make_zero_accum_superstep`): this is
+where ZERO2's memory story pays off — each microbatch's gradients are
+reduce-scattered as backward produces them and SUMMED INTO THE SHARDED
+LAYOUT, so the fp32 accumulator costs ~1/N per device instead of a full
+replicated tree, and the barrier token threads through the microbatch
+scan so bucket flushes stay ordered across microbatches (microbatch i's
+collective traffic overlaps microbatch i+1's backward on hardware with
+async collectives — `collective_overlap_fraction` reports the structural
+number). One param allgather per OPTIMIZER step, not per microbatch.
 """
 from __future__ import annotations
 
@@ -52,7 +62,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import MeshAxes
 from .sharding import _fsdp_spec_for, _opt_sharding_like
 
-__all__ = ["ZeroConfig", "assign_buckets", "make_zero_step",
+__all__ = ["ZeroConfig", "assign_buckets", "collective_overlap_fraction",
+           "make_zero_accum_superstep", "make_zero_step",
            "zero_grad_specs", "zero_opt_shardings"]
 
 DEFAULT_BUCKET_MB = 4.0
@@ -154,6 +165,148 @@ def _check_updaters(model):
                 "this model")
 
 
+class _ZeroPlan:
+    """The static ZeRO layout + traced building blocks, shared by the
+    per-batch step (`make_zero_step`) and the accumulated superstep
+    (`make_zero_accum_superstep`): per-leaf shard specs, gradient buckets
+    in backward-production order, the bucketed reduce-scatter with its
+    optimization_barrier ordering token, shard constraints for params /
+    optimizer moments / fp32 accumulators, and the static per-step
+    accounting (`info`) telemetry consumes."""
+
+    def __init__(self, model, mesh: Mesh, data_axis: str,
+                 config: ZeroConfig):
+        if config.stage not in (1, 2):
+            raise ValueError(
+                f"ZeRO stage must be 1 or 2, got {config.stage}")
+        if config.stage == 1 and config.reduce_dtype is not None:
+            # silently ignoring the knob would let a user believe they
+            # halved the wire payload; only stage 2 owns the reduction
+            raise ValueError(
+                "reduce_dtype (zero_reduce_dtype=) only applies to ZERO2 "
+                "— stage 1 reduces gradients in their own dtype; use "
+                "ShardingStrategy.ZERO2 or drop the knob")
+        _check_updaters(model)
+        self.config = config
+
+        # ---- static layout: one spec/sharding per param leaf ------------
+        leaves, self.treedef = jax.tree_util.tree_flatten(model.params)
+        specs = jax.tree_util.tree_leaves(
+            zero_grad_specs(model.params, mesh, data_axis), is_leaf=_is_p)
+        self.shardings = [NamedSharding(mesh, s) for s in specs]
+        shapes = [np.shape(l) for l in leaves]
+        counts = [int(np.prod(s, dtype=np.int64)) if s else 1
+                  for s in shapes]
+        itemsize = [np.dtype(jnp.result_type(l)).itemsize for l in leaves]
+        red_itemsize = (np.dtype(config.reduce_dtype).itemsize
+                        if config.reduce_dtype is not None else None)
+
+        # buckets pack the REVERSED leaf order: backward produces the last
+        # layer's gradients first, so reverse-forward order approximates
+        # the order buckets fill in PyTorch DDP
+        order = list(range(len(leaves)))[::-1]
+        wire = lambda i: counts[i] * (red_itemsize or itemsize[i])
+        self.buckets = [[order[j] for j in b] for b in assign_buckets(
+            [wire(i) for i in order], int(config.bucket_mb * (1 << 20)))]
+
+        sharded_idx = [i for i, s in enumerate(specs) if _nontrivial(s)]
+        self.sharded_set = set(sharded_idx)
+        rs_bytes = sum(wire(i) for i in sharded_idx)
+        full_bytes = sum(wire(i) for i in range(len(leaves)))
+        ag_bytes = sum(counts[i] * itemsize[i] for i in sharded_idx)
+        n_dev = int(mesh.shape[data_axis])
+        # fp32 gradient-accumulator footprint per device: sharded leaves
+        # land 1/N per device under ZERO2's post-reduce-scatter layout,
+        # vs the full tree when accumulating replicated (the memory story
+        # tests/test_accumulation.py and the DP-accum bench assert)
+        acc_sharded = sum(
+            (-(-counts[i] // n_dev) if i in self.sharded_set else counts[i])
+            * 4 for i in range(len(leaves)))
+        acc_repl = sum(counts[i] * 4 for i in range(len(leaves)))
+        self.info = {
+            "stage": config.stage,
+            "n_buckets": len(self.buckets) if config.stage >= 2 else 0,
+            "sharded_leaves": len(sharded_idx),
+            "replicated_leaves": len(leaves) - len(sharded_idx),
+            "devices": n_dev,
+            "accum_bytes": {"sharded": acc_sharded,
+                            "replicated": acc_repl},
+            # logical payload per step (what the wire carries, not
+            # ×(N-1)/N)
+            "bytes": ({"reduce_scatter": rs_bytes,
+                       "all_reduce": full_bytes - rs_bytes,
+                       "all_gather": ag_bytes}
+                      if config.stage >= 2 else
+                      {"reduce_scatter": 0,
+                       "all_reduce": sum(counts[i] * itemsize[i]
+                                         for i in range(len(leaves))),
+                       "all_gather": ag_bytes}),
+        }
+
+        # optimizer-state constraints (same specs, matched by shape)
+        opt_sh_tree = zero_opt_shardings(model.updater_state, model.params,
+                                         mesh, data_axis)
+        self.opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
+        self.opt_treedef = jax.tree_util.tree_structure(model.updater_state)
+
+    # ---- the gradient reduction (stage 2): bucketed reduce-scatter ------
+    def reduce_scatter(self, grads, token=None):
+        """Bucketed reduce-scatter of a gradient tree. `token` chains the
+        optimization_barrier ordering ACROSS calls: inside one backward it
+        keeps XLA from collapsing the per-bucket flushes into one
+        end-of-backward monolith, and threaded through the accumulation
+        scan's carry it extends the same ordering across the MICROBATCH
+        boundary — microbatch i's buckets flush before microbatch i+1's,
+        so their traffic can overlap i+1's backward compute. Returns
+        (grads, token) with token a float32 scalar."""
+        config = self.config
+        flat = jax.tree_util.tree_leaves(grads)
+        dtypes = [g.dtype for g in flat]
+        out = list(flat)
+        if config.reduce_dtype is not None:
+            rd = jnp.dtype(config.reduce_dtype)
+            out = [g.astype(rd) for g in out]
+        for bucket in self.buckets:
+            vals = [out[i] for i in bucket]
+            if token is not None and config.ordered_flush:
+                # chain: this bucket's reduction may not be hoisted before
+                # (or merged with) the previous bucket's flush
+                *vals, _ = jax.lax.optimization_barrier(
+                    tuple(vals) + (token,))
+            vals = [jax.lax.with_sharding_constraint(v, self.shardings[i])
+                    if i in self.sharded_set else v
+                    for v, i in zip(vals, bucket)]
+            for v, i in zip(vals, bucket):
+                out[i] = v
+            t = vals[0]
+            t = t if t.ndim == 0 else t[(0,) * t.ndim]
+            token = t.astype(jnp.float32)
+        if config.reduce_dtype is not None:
+            # fp32 master update: widen back after the narrow reduction
+            out = [g.astype(dt) for g, dt in zip(out, dtypes)]
+        return jax.tree_util.tree_unflatten(self.treedef, out), token
+
+    def constrain_params(self, tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        flat = [jax.lax.with_sharding_constraint(v, self.shardings[i])
+                if i in self.sharded_set else v
+                for i, v in enumerate(flat)]
+        return jax.tree_util.tree_unflatten(self.treedef, flat)
+
+    def constrain_opt(self, tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        flat = [jax.lax.with_sharding_constraint(v, s)
+                for v, s in zip(flat, self.opt_sh_leaves)]
+        return jax.tree_util.tree_unflatten(self.opt_treedef, flat)
+
+    def constrain_acc(self, tree):
+        """Pin a param-shaped fp32 ACCUMULATOR tree to the shard layout —
+        under ZERO2 each device holds only its 1/N of every accumulated
+        (sharded) leaf, the post-reduce-scatter layout the per-microbatch
+        sums land in."""
+        return self.constrain_params(tree)
+
+
 def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
                    config: ZeroConfig = ZeroConfig()
                    ) -> Tuple[Any, Dict[str, Any]]:
@@ -169,158 +322,147 @@ def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
     feeds telemetry: logical collective payload bytes by op and the
     gradient bucket count.
     """
-    from ..nn.graph import ComputationGraph
-
-    if config.stage not in (1, 2):
-        raise ValueError(f"ZeRO stage must be 1 or 2, got {config.stage}")
-    if config.stage == 1 and config.reduce_dtype is not None:
-        # silently ignoring the knob would let a user believe they halved
-        # the wire payload; only stage 2 owns the gradient reduction
-        raise ValueError(
-            "reduce_dtype (zero_reduce_dtype=) only applies to ZERO2 — "
-            "stage 1 reduces gradients in their own dtype; use "
-            "ShardingStrategy.ZERO2 or drop the knob")
-    _check_updaters(model)
-    is_graph = isinstance(model, ComputationGraph)
-
-    # ---- static layout: one spec/sharding per param leaf ----------------
-    leaves, treedef = jax.tree_util.tree_flatten(model.params)
-    specs = jax.tree_util.tree_leaves(
-        zero_grad_specs(model.params, mesh, data_axis), is_leaf=_is_p)
-    shardings = [NamedSharding(mesh, s) for s in specs]
-    shapes = [np.shape(l) for l in leaves]
-    counts = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
-    itemsize = [np.dtype(jnp.result_type(l)).itemsize for l in leaves]
-    red_itemsize = (np.dtype(config.reduce_dtype).itemsize
-                    if config.reduce_dtype is not None else None)
-
-    # buckets pack the REVERSED leaf order: backward produces the last
-    # layer's gradients first, so reverse-forward order approximates the
-    # order buckets fill in PyTorch DDP
-    order = list(range(len(leaves)))[::-1]
-    wire = lambda i: counts[i] * (red_itemsize or itemsize[i])
-    buckets = [[order[j] for j in b] for b in assign_buckets(
-        [wire(i) for i in order], int(config.bucket_mb * (1 << 20)))]
-
-    sharded_idx = [i for i, s in enumerate(specs) if _nontrivial(s)]
-    sharded_set = set(sharded_idx)
-    rs_bytes = sum(wire(i) for i in sharded_idx)
-    full_bytes = sum(wire(i) for i in range(len(leaves)))
-    ag_bytes = sum(counts[i] * itemsize[i] for i in sharded_idx)
-    info = {
-        "stage": config.stage,
-        "n_buckets": len(buckets) if config.stage >= 2 else 0,
-        "sharded_leaves": len(sharded_idx),
-        "replicated_leaves": len(leaves) - len(sharded_idx),
-        # logical payload per step (what the wire carries, not ×(N-1)/N)
-        "bytes": ({"reduce_scatter": rs_bytes,
-                   "all_reduce": full_bytes - rs_bytes,
-                   "all_gather": ag_bytes}
-                  if config.stage >= 2 else
-                  {"reduce_scatter": 0,
-                   "all_reduce": sum(counts[i] * itemsize[i]
-                                     for i in range(len(leaves))),
-                   "all_gather": ag_bytes}),
-    }
-
-    # optimizer-state constraints (same specs, matched by shape)
-    opt_sh_tree = zero_opt_shardings(model.updater_state, model.params,
-                                     mesh, data_axis)
-    opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
-    opt_treedef = jax.tree_util.tree_structure(model.updater_state)
-
-    # ---- the gradient reduction (stage 2): bucketed reduce-scatter ------
-    def _reduce_scatter(grads):
-        flat = jax.tree_util.tree_leaves(grads)
-        dtypes = [g.dtype for g in flat]
-        out = list(flat)
-        if config.reduce_dtype is not None:
-            rd = jnp.dtype(config.reduce_dtype)
-            out = [g.astype(rd) for g in out]
-        token = None
-        for bucket in buckets:
-            vals = [out[i] for i in bucket]
-            if token is not None and config.ordered_flush:
-                # chain: this bucket's reduction may not be hoisted before
-                # (or merged with) the previous bucket's flush
-                *vals, _ = jax.lax.optimization_barrier(
-                    tuple(vals) + (token,))
-            vals = [jax.lax.with_sharding_constraint(v, shardings[i])
-                    if i in sharded_set else v
-                    for v, i in zip(vals, bucket)]
-            for v, i in zip(vals, bucket):
-                out[i] = v
-            t = vals[0]
-            token = t if t.ndim == 0 else t[(0,) * t.ndim]
-        if config.reduce_dtype is not None:
-            # fp32 master update: widen back after the narrow reduction
-            out = [g.astype(dt) for g, dt in zip(out, dtypes)]
-        return jax.tree_util.tree_unflatten(treedef, out)
-
-    def _constrain_params(tree):
-        flat = jax.tree_util.tree_leaves(tree)
-        flat = [jax.lax.with_sharding_constraint(v, shardings[i])
-                if i in sharded_set else v
-                for i, v in enumerate(flat)]
-        return jax.tree_util.tree_unflatten(treedef, flat)
-
-    def _constrain_opt(tree):
-        flat = jax.tree_util.tree_leaves(tree)
-        flat = [jax.lax.with_sharding_constraint(v, s)
-                for v, s in zip(flat, opt_sh_leaves)]
-        return jax.tree_util.tree_unflatten(opt_treedef, flat)
-
-    # ---- grad half (mirrors each family's _make_train_step) -------------
-    base_loss = model._loss_fn
-    remat = getattr(model.conf.conf, "remat", None) == "full"
-    minimize = model.conf.conf.minimize
-
-    if is_graph:
-        def grad_fn(params, state, x, y, rng, fm, lm):
-            f = base_loss
-            if remat:
-                f = jax.checkpoint(lambda p, s, x_, y_, r_: base_loss(
-                    p, s, x_, y_, r_, fmasks=fm, lmasks=lm))
-                (score, new_state), grads = jax.value_and_grad(
-                    f, has_aux=True)(params, state, x, y, rng)
-            else:
-                (score, new_state), grads = jax.value_and_grad(
-                    f, has_aux=True)(params, state, x, y, rng,
-                                     fmasks=fm, lmasks=lm)
-            return score, new_state, grads
-    else:
-        def grad_fn(params, state, x, y, rng, fm, lm):
-            f = base_loss
-            if remat:
-                f = jax.checkpoint(lambda p, s, x_, y_, r_: base_loss(
-                    p, s, x_, y_, r_, fmask=fm, lmask=lm))
-                (score, (new_state, _)), grads = jax.value_and_grad(
-                    f, has_aux=True)(params, state, x, y, rng)
-            else:
-                (score, (new_state, _)), grads = jax.value_and_grad(
-                    f, has_aux=True)(params, state, x, y, rng,
-                                     fmask=fm, lmask=lm)
-            return score, new_state, grads
+    plan = _ZeroPlan(model, mesh, data_axis, config)
+    # the model's grad half (loss selection incl. remat + minimize sign)
+    grad_fn = model.grad_step_fn
 
     def step(params, state, opt_state, step_i, x, y, rng, fmask, lmask):
         score, new_state, grads = grad_fn(params, state, x, y, rng,
                                           fmask, lmask)
-        if not minimize:
-            grads = jax.tree_util.tree_map(lambda g: -g, grads)
         if config.stage >= 2:
-            grads = _reduce_scatter(grads)
-        if is_graph:
-            new_params, new_opt = model.apply_vertex_updates(
-                params, grads, opt_state, step_i)
-        else:
-            np_, no_ = model.apply_layer_updates(
-                model.layers, params, grads, opt_state, step_i)
-            new_params, new_opt = tuple(np_), tuple(no_)
+            grads, _ = plan.reduce_scatter(grads)
+        new_params, new_opt = model.apply_updates(params, grads, opt_state,
+                                                  step_i)
         # each device computes only ITS shard of the new params and
         # moments; the jit's replicated param out-sharding is then the
         # trailing ZeRO allgather
-        new_params = _constrain_params(new_params)
-        new_opt = _constrain_opt(new_opt)
+        new_params = plan.constrain_params(new_params)
+        new_opt = plan.constrain_opt(new_opt)
         return new_params, new_state, new_opt, score
 
-    return step, info
+    return step, plan.info
+
+
+def make_zero_accum_superstep(model, mesh: Mesh, *,
+                              data_axis: str = MeshAxes.DATA,
+                              config: ZeroConfig = ZeroConfig(),
+                              skip_nonfinite: bool = False
+                              ) -> Tuple[Any, Dict[str, Any]]:
+    """The ZeRO ACCUMULATED superstep (ISSUE 12): a nested scan over
+    [K, M, batch, ...] windows — outer over K optimizer steps, inner over
+    each step's M microbatches — where ZERO2 accumulates into the
+    *post-reduce-scatter sharded* layout:
+
+      * every microbatch's gradients are bucket-reduce-scattered as its
+        backward produces them, and the fp32 accumulator is CONSTRAINED to
+        the shard specs, so per-device accumulator memory is ~1/N of the
+        replicated tree (`info["accum_bytes"]`);
+      * the optimization_barrier token threads through the scan carry, so
+        microbatch i's bucket flushes stay ordered before microbatch
+        i+1's — on hardware with async collectives, i's reduce-scatter
+        traffic overlaps i+1's backward compute (the structural overlap
+        `collective_overlap_fraction` reports);
+      * the update then runs once per outer step on the sharded mean, and
+        the jit's replicated param out-sharding is the trailing
+        allgather — ONE allgather per optimizer step, not per microbatch.
+
+    ZERO1 accumulates the unreduced gradient tree (full-size accumulator,
+    the classic stage-1 memory story) and lets XLA place the single
+    deferred reduction at the update's shard constraints.
+
+    Signature matches ``nn/superstep.build_accum_superstep``: returns
+    (params, state, opt, rng, scores[K], micro_scores[K, M]); the trainer
+    jits it with the training shardings and donation. `skip_nonfinite`
+    mirrors the generic builder (zero the bad microbatch's gradient,
+    renormalize over the finite ones).
+    """
+    plan = _ZeroPlan(model, mesh, data_axis, config)
+    grad_fn = model.grad_step_fn
+    stage2 = config.stage >= 2
+
+    def superstep(params, state, opt_state, step0, rng0, xs, ys, fm, lm):
+        f32 = jnp.float32
+
+        def opt_body(carry, inp):
+            params, state, opt, step, rng, token = carry
+
+            def micro_body(mcarry, minp):
+                state, rng, acc, n_ok, ssum, token = mcarry
+                x, y, f, l = minp
+                rng, k = jax.random.split(rng)
+                score, new_state, grads = grad_fn(params, state, x, y, k,
+                                                  f, l)
+                if stage2:
+                    grads, token = plan.reduce_scatter(grads, token)
+                if skip_nonfinite:
+                    # where-select, never multiply: 0 * NaN is NaN, and a
+                    # poisoned gradient/state must not touch the carry
+                    ok = jnp.isfinite(score)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + jnp.where(ok, g.astype(f32), 0.0),
+                        acc, grads)
+                    state = jax.tree_util.tree_map(
+                        lambda o, n_: jnp.where(ok, n_, o), state,
+                        new_state)
+                    n_ok = n_ok + ok.astype(f32)
+                    ssum = ssum + jnp.where(ok, score, 0.0)
+                else:
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(f32), acc, grads)
+                    state = new_state
+                    n_ok = n_ok + 1.0
+                    ssum = ssum + score
+                if stage2:
+                    # keep the running sum pinned to the shard layout —
+                    # the accumulator never materializes replicated
+                    acc = plan.constrain_acc(acc)
+                return (state, rng, acc, n_ok, ssum, token), score
+
+            acc0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), f32), params)
+            if stage2:
+                acc0 = plan.constrain_acc(acc0)
+            (state, rng, acc, n_ok, ssum, token), mscores = jax.lax.scan(
+                micro_body, (state, rng, acc0, f32(0.0), f32(0.0), token),
+                inp)
+            denom = jnp.maximum(n_ok, 1.0)
+            gmean = jax.tree_util.tree_map(
+                lambda a, p: (a / denom).astype(jnp.result_type(p)),
+                acc, params)
+            if stage2:
+                gmean = plan.constrain_acc(gmean)
+            new_params, new_opt = model.apply_updates(params, gmean, opt,
+                                                      step)
+            new_params = plan.constrain_params(new_params)
+            new_opt = plan.constrain_opt(new_opt)
+            score = jnp.where(n_ok > 0, ssum / denom, jnp.nan)
+            return ((new_params, state, new_opt, step + 1, rng, token),
+                    (score, mscores))
+
+        token0 = jnp.zeros((), jnp.float32)
+        ((params, state, opt, _step, rng, _token),
+         (scores, mscores)) = jax.lax.scan(
+            opt_body, (params, state, opt_state, step0, rng0, token0),
+            (xs, ys, fm, lm))
+        return params, state, opt, rng, scores, mscores
+
+    return superstep, plan.info
+
+
+def collective_overlap_fraction(info: Dict[str, Any], m: int) -> float:
+    """Structural collective/compute overlap for the telemetry gauge
+    ``dl4j_collective_overlap_fraction``: the fraction of the per-step
+    reduce-scatter payload issued while independent backward compute
+    remains in flight to hide it. With M accumulation microbatches and B
+    buckets per backward, M·B flushes are issued per optimizer step and
+    every one except the LAST still has backward work behind it (the next
+    bucket's producers, or the next microbatch entirely) — so the
+    fraction is 1 - 1/(M·B). Stage 1 defers its reduction to the step end
+    (nothing scheduled to overlap): 0.0. This is schedule accounting, not
+    a wall-clock measurement — the single-process CPU mesh serializes
+    collectives, so the wall-clock number needs a real pod (same caveat
+    as the ZeRO efficiency gate)."""
+    if int(info.get("stage", 1)) < 2 or not info.get("n_buckets"):
+        return 0.0
+    flushes = max(1, int(m)) * int(info["n_buckets"])
+    return round(1.0 - 1.0 / flushes, 4)

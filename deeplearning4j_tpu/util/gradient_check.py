@@ -69,7 +69,9 @@ def check_gradients_fn(
             new_leaves[idx_leaf] = leaf.reshape(-1).at[coord].set(
                 value).reshape(leaf.shape)
             return loss_fn(jax.tree_util.tree_unflatten(treedef, new_leaves))
-        return jax.jit(jax.vmap(one))(coords, values)
+        from ..telemetry.compile_watch import watch_compiles
+        return watch_compiles(jax.jit(jax.vmap(one)),
+                              "util/gradient_check")(coords, values)
 
     for li, ((path, leaf), grad) in enumerate(zip(flat, aflat)):
         n = leaf.size

@@ -25,6 +25,14 @@
 #                            guard rollback, non-aligned resume) plus one
 #                            paired bench rep printing the superstep-vs-
 #                            perbatch speedup + dispatch-span share
+#   ./runtests.sh accum      gradient-accumulation smoke: the
+#                            fit(grad_accumulation=M) equivalence suite
+#                            (M×b vs M·b both families, ZERO2 sharded
+#                            accumulators, guard micro-skip, mid-
+#                            accumulation kill+resume) plus one paired
+#                            accum-vs-native bench rep on the 8-dev mesh
+#                            (throughput ratio, accumulator memory,
+#                            overlap fraction)
 #   ./runtests.sh lint       graftlint static pass (jit/tracer hygiene,
 #                            recompile hazards, donation safety,
 #                            concurrency lint) against the checked-in
@@ -61,6 +69,15 @@ if [[ "${1:-}" == "superstep" ]]; then
 from deeplearning4j_tpu.models.zoo import bench_lenet_superstep
 print(json.dumps(bench_lenet_superstep(batch=128, n_batches=8, epochs=2),
                  indent=1))'
+fi
+if [[ "${1:-}" == "accum" ]]; then
+    echo "=== gradient-accumulation equivalence smoke ==="
+    python -m pytest tests/test_accumulation.py -q
+    echo "=== paired accum-vs-native bench rep (zero2, effective b256) ==="
+    exec env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --mode accum --steps 2 --reps 2
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

@@ -1,0 +1,474 @@
+"""Microbatch gradient accumulation (ISSUE 12): fit(grad_accumulation=M).
+
+Equivalence contract under test:
+
+  * "One batch of M·b rows" and "M microbatches of b rows" are the SAME
+    BITS through the accumulation engine — `split_microbatches` slices a
+    big batch into the identical [M, b, ...] window a native microbatch
+    iterator stages, asserted bit-exact (dropout included).
+  * Against a NATIVE M·b big-batch fit the only difference is XLA's
+    reassociation of the batch reduction (chunked fp32 sums vs one fused
+    contraction), asserted allclose at f32-ulp scale — the same tolerance
+    class the ZeRO suite documents for collective reassociation.
+  * Grouping is free: accumulation composes with any superstep K (and
+    the overlap-aware auto-K) bit-exactly, because the per-microbatch op
+    sequence is identical for every (K, M) regrouping.
+
+Cadence contract: listeners/iteration_count/updater `step` advance per
+OPTIMIZER step; the checkpoint batch cursor counts iterator microbatches
+and only lands on optimizer-step boundaries, so kill+resume around a
+non-step-aligned microbatch ordinal is bit-exact.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.datasets.iterators import (ArrayDataSetIterator,
+                                                   ListDataSetIterator)
+from deeplearning4j_tpu.datasets.pipeline import split_microbatches
+from deeplearning4j_tpu.fault.guard import (GuardPolicy, NonFiniteScoreError,
+                                            TrainingGuard)
+from deeplearning4j_tpu.fault.injection import FaultyIterator
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.superstep import (OverlapAutoK,
+                                             accum_skip_nonfinite,
+                                             validate_grad_accumulation)
+
+
+def _mlp(seed=7, dropout=0.0):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_out=32, activation="relu",
+                              dropout=dropout or None))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=7):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(1e-3))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(12)))
+    b.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+    b.add_layer("out", OutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"), "d")
+    b.set_outputs("out")
+    return ComputationGraph(b.build()).init()
+
+
+def _data(n, f=12, c=5, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[r.integers(0, c, n)]
+    return x, y
+
+
+def _it(x, y, batch=16):
+    return ArrayDataSetIterator(x, y, batch_size=batch)
+
+
+def _batches(x, y, batch=16):
+    return [DataSet(x[i:i + batch], y[i:i + batch])
+            for i in range(0, len(x), batch)]
+
+
+def _assert_bit_equal(a, b):
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for p, q in zip(fa, fb):
+        assert (np.asarray(p) == np.asarray(q)).all()
+
+
+def _assert_f32_close(a, b, rtol=5e-5, atol=1e-7):
+    for p, q in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# M×b vs M·b equivalence, both model families
+# ---------------------------------------------------------------------------
+def test_accum_matches_native_bigbatch_mlp():
+    """M=4 microbatches of b=16 vs one native batch of 64: identical in
+    exact arithmetic (mean of per-microbatch mean-gradients), allclose at
+    f32-ulp in floats — XLA computes the native batch reduction in one
+    fused contraction where accumulation sums M chunked fp32 partials."""
+    x, y = _data(8 * 16)
+    a = _mlp()
+    a.fit(_it(x, y, 16), epochs=2, grad_accumulation=4)
+    b = _mlp()
+    b.fit(_it(x, y, 64), epochs=2)
+    assert a.iteration_count == b.iteration_count == 4
+    assert a.epoch_count == b.epoch_count == 2
+    _assert_f32_close(a.params, b.params)
+    _assert_f32_close(a.updater_state, b.updater_state)
+
+
+def test_accum_matches_native_bigbatch_graph():
+    x, y = _data(8 * 16)
+    a = _graph()
+    a.fit(_it(x, y, 16), epochs=2, grad_accumulation=4)
+    b = _graph()
+    b.fit(_it(x, y, 64), epochs=2)
+    assert a.iteration_count == b.iteration_count
+    _assert_f32_close(a.params, b.params)
+
+
+@pytest.mark.parametrize("family", ["mlp", "graph"])
+def test_accum_split_bigbatch_bitexact(family):
+    """One batch of M·b rows run through `split_microbatches` IS M
+    microbatches of b rows — same slices, same [M, b, ...] staged window,
+    bit-exact params/updater/RNG (dropout included for the MLP: each
+    microbatch draws the same key chain either way)."""
+    x, y = _data(6 * 16)
+    mk = ((lambda: _mlp(dropout=0.5)) if family == "mlp" else _graph)
+    a = mk()
+    a.fit(_it(x, y, 16), epochs=2, grad_accumulation=3)
+    b = mk()
+    b.fit(split_microbatches(_it(x, y, 48), 16), epochs=2,
+          grad_accumulation=3)
+    _assert_bit_equal(a.params, b.params)
+    _assert_bit_equal(a.updater_state, b.updater_state)
+    assert (np.asarray(a._rng) == np.asarray(b._rng)).all()
+    assert a.iteration_count == b.iteration_count == 4
+
+
+def test_accum_superstep_composition_bitexact():
+    """Accumulation is grouping-invariant across the superstep knob: K=1,
+    K=3, 'epoch' and the overlap-aware 'auto' all produce identical bits
+    for the same M (windows are a pure regrouping of the identical
+    per-microbatch math)."""
+    x, y = _data(6 * 16)
+    ref = _mlp(dropout=0.5)
+    ref.fit(_it(x, y), epochs=2, grad_accumulation=2)
+    for knob in (3, "epoch", "auto"):
+        m = _mlp(dropout=0.5)
+        m.fit(_it(x, y), epochs=2, grad_accumulation=2, superstep=knob)
+        _assert_bit_equal(ref.params, m.params)
+        _assert_bit_equal(ref.updater_state, m.updater_state)
+        assert (np.asarray(ref._rng) == np.asarray(m._rng)).all()
+        assert m.iteration_count == ref.iteration_count == 6
+
+
+def test_accum_tail_group_renormalizes():
+    """An epoch tail shorter than M trains as its own optimizer step with
+    the mean over its microbatches: 9 micros at M=4 -> steps of (4, 4, 1),
+    and the 1-micro step is bit-identical to a plain step on that batch."""
+    x, y = _data(9 * 16)
+    a = _mlp()
+    a.fit(_it(x, y), epochs=1, grad_accumulation=4)
+    assert a.iteration_count == 3
+
+    b = _mlp()
+    b.fit(ListDataSetIterator(_batches(x[:8 * 16], y[:8 * 16])), epochs=1,
+          grad_accumulation=4)
+    b.fit(ListDataSetIterator(_batches(x[8 * 16:], y[8 * 16:])), epochs=1,
+          grad_accumulation=4)   # one leftover micro -> renormalized step
+    _assert_bit_equal(a.params, b.params)
+    _assert_bit_equal(a.updater_state, b.updater_state)
+
+
+def test_accum_listener_cadence_per_optimizer_step():
+    """iteration_done fires once per OPTIMIZER step (not per microbatch),
+    consuming a HOST scalar score from the transferred loss vector."""
+    from deeplearning4j_tpu.optimize.listeners import (IterationListener,
+                                                       PerformanceListener)
+
+    seen = []
+
+    class Probe(IterationListener):
+        def iteration_done(self, model, iteration):
+            seen.append((iteration, model._score,
+                         isinstance(model._score, (float, np.floating))))
+
+    x, y = _data(8 * 16)
+    m = _mlp()
+    perf = PerformanceListener(frequency=1, report_score=True,
+                               printer=lambda s: None)
+    m.set_listeners(Probe(), perf)
+    m.fit(_it(x, y), epochs=1, grad_accumulation=4)
+    assert [i for i, _, _ in seen] == [1, 2]   # 8 micros -> 2 steps
+    assert all(host for _, _, host in seen), "device score leaked"
+    assert all(np.isfinite(s) for _, s, _ in seen)
+    assert len(perf.history) == 2
+
+
+# ---------------------------------------------------------------------------
+# guard under accumulation
+# ---------------------------------------------------------------------------
+def test_accum_guard_skips_only_bad_microbatch():
+    """skip_batch + M>1: a non-finite microbatch loss zeroes ONLY that
+    microbatch's gradient and the mean renormalizes over the finite ones
+    — bit-identical to an accumulation run with the bad microbatch simply
+    absent from its group (ISSUE 12 satellite)."""
+    x, y = _data(6 * 16)
+    bs = _batches(x, y)
+
+    m = _mlp()
+    it = FaultyIterator(ListDataSetIterator(list(bs)), nan_at=1)
+    guard = TrainingGuard(policy=GuardPolicy.SKIP_BATCH)
+    m.fit(it, epochs=1, grad_accumulation=3, guard=guard)
+    assert m.iteration_count == 2
+    assert guard.nonfinite_steps == 1
+    assert guard.skipped_batches == 1
+
+    # reference: same data with micro #1 removed — step 1 accumulates the
+    # remaining two micros (mean over 2), step 2 is untouched. RNG keys
+    # differ in count (the poisoned run still drew a key for the bad
+    # micro) but are unused without dropout, so params match bit-exactly.
+    ref = _mlp()
+    ref.fit(ListDataSetIterator([bs[0], bs[2]]), epochs=1,
+            grad_accumulation=2)
+    ref.fit(ListDataSetIterator(bs[3:]), epochs=1, grad_accumulation=3)
+    _assert_bit_equal(ref.params, m.params)
+    _assert_bit_equal(ref.updater_state, m.updater_state)
+
+
+def test_accum_guard_all_bad_step_discards_window():
+    """When EVERY microbatch of a step is non-finite the renormalized
+    score is NaN and the whole-window skip_batch policy restores the
+    pre-window snapshot — the poisoned step never happened."""
+    x, y = _data(4 * 16)
+    bs = _batches(x, y)
+    m = _mlp()
+    it = FaultyIterator(FaultyIterator(ListDataSetIterator(list(bs)),
+                                       nan_at=0), nan_at=1)
+    guard = TrainingGuard(policy=GuardPolicy.SKIP_BATCH)
+    m.fit(it, epochs=1, grad_accumulation=2, guard=guard)
+    assert m.iteration_count == 1   # only step 2 survived
+
+    ref = _mlp()
+    ref.fit(ListDataSetIterator(bs[2:]), epochs=1, grad_accumulation=2)
+    _assert_bit_equal(ref.params, m.params)
+    assert (np.asarray(ref._rng) == np.asarray(m._rng)).all()
+
+
+def test_accum_guard_halt_raises():
+    x, y = _data(4 * 16)
+    m = _mlp()
+    it = FaultyIterator(_it(x, y), nan_at=1)
+    with pytest.raises(NonFiniteScoreError):
+        m.fit(it, epochs=1, grad_accumulation=2,
+              guard=TrainingGuard(policy=GuardPolicy.HALT))
+
+
+def test_accum_skip_nonfinite_predicate():
+    g = TrainingGuard(policy=GuardPolicy.SKIP_BATCH)
+    assert accum_skip_nonfinite(g, 4)
+    assert not accum_skip_nonfinite(g, 1)
+    assert not accum_skip_nonfinite(None, 4)
+    assert not accum_skip_nonfinite(
+        TrainingGuard(policy=GuardPolicy.ROLLBACK), 4)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+def test_accum_kill_mid_accumulation_resume_bitexact(tmp_path):
+    """Kill at microbatch ordinal 7 — inside step 3's accumulation group
+    (micros 6..8), a NON-step-aligned ordinal. The last checkpoint sits at
+    the step boundary (micro 6); resume re-draws the trained prefix and
+    regroups identically, matching the uninterrupted run bit-exactly."""
+    d = str(tmp_path / "ckpt")
+    x, y = _data(9 * 16)
+
+    ref = _mlp()
+    ref.fit(_it(x, y), epochs=2, grad_accumulation=3)
+
+    m1 = _mlp()
+    it = FaultyIterator(_it(x, y), raise_at=7, exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        m1.fit(it, epochs=2, grad_accumulation=3, checkpoint_dir=d,
+               checkpoint_every=1)
+
+    m2 = _mlp()
+    m2.fit(_it(x, y), epochs=2, grad_accumulation=3, checkpoint_dir=d,
+           resume=True)
+    _assert_bit_equal(ref.params, m2.params)
+    _assert_bit_equal(ref.updater_state, m2.updater_state)
+    assert (np.asarray(ref._rng) == np.asarray(m2._rng)).all()
+    assert ref.iteration_count == m2.iteration_count
+
+
+def test_accum_resume_mismatched_m_warns(tmp_path, caplog):
+    """The checkpoint records grad_accumulation; resuming with a different
+    M warns — unlike superstep grouping, M changes the math."""
+    d = str(tmp_path / "ckpt")
+    x, y = _data(6 * 16)
+    m1 = _mlp()
+    it = FaultyIterator(_it(x, y), raise_at=4, exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        m1.fit(it, epochs=1, grad_accumulation=2, checkpoint_dir=d,
+               checkpoint_every=1)
+    m2 = _mlp()
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        m2.fit(_it(x, y), epochs=1, grad_accumulation=3, checkpoint_dir=d,
+               resume=True)
+    assert any("grad_accumulation" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# knob validation + auto-K policy
+# ---------------------------------------------------------------------------
+def test_grad_accumulation_validation():
+    assert validate_grad_accumulation(1) == 1
+    assert validate_grad_accumulation(8) == 8
+    for bad in (0, -1, 1.5, "lots", None):
+        with pytest.raises(ValueError, match="grad_accumulation"):
+            validate_grad_accumulation(bad)
+    x, y = _data(16)
+    with pytest.raises(ValueError, match="grad_accumulation"):
+        _mlp().fit(DataSet(x, y), grad_accumulation=2)
+
+
+def test_overlap_autok_grows_on_dispatch_share():
+    """The overlap-aware auto-K policy doubles K while the measured
+    dispatch share of the window period exceeds target, holds below it,
+    and caps at max_k — never shrinks (compile thrash)."""
+    ak = OverlapAutoK(2, max_k=16, target_share=0.10)
+    assert ak.observe(0.5, 1.0) == 4       # 50% share -> grow
+    assert ak.observe(0.5, 1.0) == 8
+    assert ak.observe(0.5, 1.0) == 16
+    assert ak.observe(0.5, 1.0) == 16      # capped
+    ak2 = OverlapAutoK(4, max_k=64, target_share=0.10)
+    for _ in range(5):
+        assert ak2.observe(0.01, 1.0) == 4  # 1% share -> hold
+    assert ak2.observe(0.0, 0.0) == 4      # degenerate period ignored
+
+
+# ---------------------------------------------------------------------------
+# ParallelTrainer composition (8-dev virtual mesh via conftest XLA_FLAGS)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("strategy", ["replicated", "zero1", "zero2"])
+def test_trainer_accum_matches_native_bigbatch(strategy):
+    """8×b32 accumulated (M=4 -> effective b128) vs native b128 on the
+    8-device mesh, for the plain SYNC step and both ZeRO stages —
+    allclose at the f32-ulp tolerance the ZeRO suite documents."""
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    x, y = _data(8 * 32)
+    ta = ParallelTrainer(_mlp(), strategy=strategy)
+    ta.fit(_it(x, y, 32), epochs=2, grad_accumulation=4)
+    tb = ParallelTrainer(_mlp(), strategy=strategy)
+    tb.fit(_it(x, y, 128), epochs=2)
+    assert ta.iteration_count == tb.iteration_count == 4
+    _assert_f32_close(ta.model.params, tb.model.params, rtol=1e-4,
+                      atol=1e-6)
+
+
+def test_trainer_zero2_sharded_vs_replicated_accumulation():
+    """ZERO2's sharded-accumulator path trains the same math as
+    replicated accumulation (f32-ulp), while its static accounting shows
+    the fp32 accumulator at ~1/N per device."""
+    from deeplearning4j_tpu.parallel import make_zero_accum_superstep
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    x, y = _data(8 * 16)
+    tz = ParallelTrainer(_mlp(), strategy="zero2")
+    tz.fit(_it(x, y, 16), epochs=2, grad_accumulation=4)
+    tr = ParallelTrainer(_mlp(), strategy="replicated")
+    tr.fit(_it(x, y, 16), epochs=2, grad_accumulation=4)
+    _assert_f32_close(tz.model.params, tr.model.params, rtol=1e-4,
+                      atol=1e-6)
+
+    # accumulator memory: a model with data-axis-divisible weight matrices
+    # shards all its big leaves; only biases stay replicated
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=8, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(128))
+            .build())
+    big = MultiLayerNetwork(conf).init()
+    mesh = make_mesh({"data": 8}, devices=jax.devices()[:8])
+    _, info = make_zero_accum_superstep(big, mesh)
+    acc = info["accum_bytes"]
+    assert acc["sharded"] < 0.2 * acc["replicated"]   # ~1/8 + bias slack
+    # replicated fp32 accumulator equals the param count in fp32
+    assert acc["replicated"] == 4 * big.num_params()
+
+
+def test_trainer_zero2_overlap_gauge_and_fraction():
+    """dl4j_collective_overlap_fraction reports the structural schedule
+    overlap 1 - 1/(M·buckets) for ZERO2 (tiny bucket bound forces one
+    bucket per leaf) and 0.0 for ZERO1's deferred reduction."""
+    from deeplearning4j_tpu.parallel import collective_overlap_fraction
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    from deeplearning4j_tpu.telemetry import runtime as telemetry_runtime
+    from deeplearning4j_tpu.telemetry.runtime import TelemetrySession
+
+    assert collective_overlap_fraction({"stage": 1, "n_buckets": 0}, 4) == 0.0
+    assert collective_overlap_fraction(
+        {"stage": 2, "n_buckets": 3}, 4) == pytest.approx(1 - 1 / 12,
+                                                          abs=1e-3)
+
+    x, y = _data(8 * 16)
+    sess = TelemetrySession()
+    with telemetry_runtime.enabled(sess):
+        t = ParallelTrainer(_mlp(), strategy="zero2",
+                            zero_bucket_mb=1e-4)   # every leaf its own bucket
+        t.fit(_it(x, y, 16), epochs=1, grad_accumulation=4)
+    g = sess.registry.get("dl4j_collective_overlap_fraction")
+    assert g is not None
+    nb = t._zero_info["n_buckets"]
+    assert nb >= 2
+    assert g.value() == pytest.approx(1 - 1 / (4 * nb), abs=1e-3)
+    # per-microbatch reduce-scatter, per-step allgather in the counters
+    dp = sess.dp_summary()
+    info = t._zero_info
+    micros, steps = 8, 2
+    assert dp["collective_bytes"]["reduce_scatter"] == \
+        info["bytes"]["reduce_scatter"] * micros
+    assert dp["collective_bytes"]["all_gather"] == \
+        info["bytes"]["all_gather"] * steps
+    assert dp["bucket_flushes"] == nb * micros
+
+
+def test_trainer_accum_rejected_where_unsupported():
+    from deeplearning4j_tpu.parallel.trainer import (ParallelTrainer,
+                                                     TrainingMode)
+
+    x, y = _data(4 * 16)
+    t = ParallelTrainer(_mlp(), collect_stats=True)
+    with pytest.raises(ValueError, match="grad_accumulation"):
+        t.fit(_it(x, y), grad_accumulation=2)
+    t2 = ParallelTrainer(_mlp(), mode=TrainingMode.AVERAGING)
+    with pytest.raises(ValueError, match="grad_accumulation"):
+        t2.fit(_it(x, y), grad_accumulation=2)
+    t3 = ParallelTrainer(_mlp())
+    with pytest.raises(ValueError, match="grad_accumulation"):
+        t3.fit(DataSet(x, y), grad_accumulation=2)
+
+
+def test_trainer_accum_guard_and_checkpoint(tmp_path):
+    """Sharded checkpoints + resume compose with trainer accumulation:
+    kill at a non-step-aligned microbatch ordinal, resume matches the
+    uninterrupted run bit-exactly (replicated strategy: exact)."""
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    d = str(tmp_path / "ckpt")
+    x, y = _data(8 * 16)
+    ref = ParallelTrainer(_mlp(), strategy="replicated")
+    ref.fit(_it(x, y, 16), epochs=1, grad_accumulation=2)
+
+    t1 = ParallelTrainer(_mlp(), strategy="replicated")
+    it = FaultyIterator(_it(x, y, 16), raise_at=5, exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        t1.fit(it, epochs=1, grad_accumulation=2, checkpoint_dir=d,
+               checkpoint_every=1)
+    t2 = ParallelTrainer(_mlp(), strategy="replicated")
+    t2.fit(_it(x, y, 16), epochs=1, grad_accumulation=2, checkpoint_dir=d,
+           resume=True)
+    _assert_bit_equal(ref.model.params, t2.model.params)
+    assert t2.iteration_count == ref.iteration_count == 4
