@@ -363,19 +363,46 @@ def main():
     except Exception:
         pass
     try:
-        # graftlint trajectory (ISSUE 9): total/new findings per rule via
-        # the CLI's --metrics machinery (dl4j_lint_findings_total{rule}),
-        # so the burn-down of baselined findings stays visible across PRs
+        # graftlint trajectory (ISSUE 9/13): total/new findings per rule
+        # via the CLI's --metrics machinery (dl4j_lint_findings_total
+        # {rule}), so the burn-down of baselined findings stays visible
+        # across PRs — the AST pass plus the IR tier (jit entry points
+        # traced/lowered/compiled on the virtual mesh) with its measured
+        # whole-package wall time
         from deeplearning4j_tpu.analysis.cli import lint_metrics
         here = os.path.dirname(os.path.abspath(__file__))
-        lm = lint_metrics([os.path.join(here, "deeplearning4j_tpu")],
-                          baseline=os.path.join(here,
-                                                "graftlint_baseline.json"))
+        pkg = [os.path.join(here, "deeplearning4j_tpu")]
+        bl = os.path.join(here, "graftlint_baseline.json")
+        lm = lint_metrics(pkg, baseline=bl)
         extras["Lint-findings"] = {"total": lm["total"], "new": lm["new"],
                                    "by_rule": lm["by_rule"],
                                    "wall_s": lm["wall_s"]}
     except Exception as e:
         extras["Lint-findings"] = f"error: {type(e).__name__}"
+    try:
+        # IR tier in its own try so a probe failure can't clobber the AST
+        # numbers above. The sharding/collective rules need a real mesh:
+        # on a 1-device backend (bench on the TPU chip, or CPU without
+        # the 8-device XLA flag) a "clean" IR run would have verified
+        # nothing — report it as skipped instead.
+        import jax
+        if jax.device_count() >= 2:
+            from deeplearning4j_tpu.analysis.cli import ir_lint_metrics
+            im = ir_lint_metrics(pkg, baseline=bl)
+            ir_extra = {
+                "total": im["total"], "new": im["new"],
+                "by_rule": im["by_rule"], "entries": im["entries"],
+                "roster": im["roster"], "devices": jax.device_count(),
+                "wall_s": im["wall_s"]}
+        else:
+            ir_extra = (f"skipped: {jax.device_count()} device(s) — the "
+                        "IR pass needs the virtual mesh (run "
+                        "./runtests.sh lint or tools/graftlint --ir)")
+        if isinstance(extras.get("Lint-findings"), dict):
+            extras["Lint-findings"]["ir"] = ir_extra
+    except Exception as e:
+        if isinstance(extras.get("Lint-findings"), dict):
+            extras["Lint-findings"]["ir"] = f"error: {type(e).__name__}"
 
     baseline = None
     try:

@@ -13,20 +13,26 @@ visible in the AST. This package turns them into enforced rules:
   * `rules_jit`   — jit/tracer hygiene, recompilation hazards, donation
                     safety (families JH/RC/DN).
   * `rules_concurrency` — threaded-state and lock discipline (family CC).
+  * `ir` / `ir_probes` — the IR tier (ISSUE 13): abstract-eval the jit
+                    entry points on the virtual 8-device mesh and verify
+                    shard layouts, collective schedules and donation
+                    aliasing in the jaxpr/lowered/compiled artifacts.
   * `sanitizer`   — the runtime side: tracer-leak/debug-nans config,
                     thread-leak watchdog, order-asserting lock shims,
-                    exposed to tests via the `sanitize` pytest marker.
+                    per-step collective-sequence hashing, exposed to
+                    tests via the `sanitize` pytest marker.
 
-CLI: `python -m tools.graftlint deeplearning4j_tpu/` (see
-`analysis.cli`). Suppression: `# graftlint: disable=<rule>[,<rule>...]`
-on the offending line, `# graftlint: disable-file=<rule>` anywhere in a
-file; accepted findings live in `graftlint_baseline.json`.
+CLI: `python -m tools.graftlint deeplearning4j_tpu/` (AST pass, pure
+stdlib) and `... --ir` (IR tier; see `analysis.cli`). Suppression:
+`# graftlint: disable=<rule>[,<rule>...]` on the offending line,
+`# graftlint: disable-file=<rule>` anywhere in a file; accepted findings
+live in `graftlint_baseline.json` (sections `findings` / `ir_findings`).
 """
 from .engine import (Finding, LintResult, Project, RULES, load_baseline,
                      run_lint, write_baseline)
-from .sanitizer import (LockOrderError, SanitizerReport, ThreadLeakError,
-                        sanitize)
+from .sanitizer import (CollectiveSequenceHasher, LockOrderError,
+                        SanitizerReport, ThreadLeakError, sanitize)
 
 __all__ = ["Finding", "LintResult", "Project", "RULES", "run_lint",
            "load_baseline", "write_baseline", "sanitize", "SanitizerReport",
-           "ThreadLeakError", "LockOrderError"]
+           "ThreadLeakError", "LockOrderError", "CollectiveSequenceHasher"]

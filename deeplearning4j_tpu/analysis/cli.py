@@ -1,12 +1,16 @@
 """graftlint CLI.
 
-    python -m tools.graftlint deeplearning4j_tpu/            # lint vs baseline
+    python -m tools.graftlint deeplearning4j_tpu/            # AST lint vs baseline
+    python -m tools.graftlint deeplearning4j_tpu/ --ir       # IR tier (jaxpr/HLO)
     python -m tools.graftlint pkg/ --write-baseline          # accept current
     python -m tools.graftlint pkg/ --metrics                 # Prometheus text
     python -m tools.graftlint --list-rules
 
 Exit codes: 0 = clean against the baseline, 1 = new findings (or stale
-baseline entries with --strict-stale), 2 = usage/parse error.
+baseline entries with --strict-stale), 2 = usage/parse error. The AST
+pass is pure stdlib; `--ir` imports jax and abstract-evals the
+package's jit entry points on the virtual 8-device mesh (baseline
+section `ir_findings` in the same baseline file).
 """
 from __future__ import annotations
 
@@ -60,7 +64,36 @@ def lint_metrics(paths: Sequence[str],
     }
 
 
-def _prometheus(res: LintResult) -> str:
+def ir_lint_metrics(paths: Sequence[str] = (),
+                    baseline: Optional[str] = None) -> Dict:
+    """IR-tier counterpart of `lint_metrics` for bench.py: runs the
+    jaxpr/HLO pass over the probe roster (requires jax + the virtual
+    mesh) and reports totals plus the measured whole-package IR wall
+    time and the watch_compiles roster size."""
+    from ..telemetry.compile_watch import roster_names
+    from .ir import run_ir_lint
+    from .ir_probes import build_entries
+
+    t0 = time.perf_counter()
+    entries = build_entries()
+    res = run_ir_lint(entries,
+                      baseline_path=_find_baseline(list(paths), baseline))
+    # count the roster while `entries` still pins the jitted fns alive
+    # (the ledger holds weakrefs)
+    n_roster = len(roster_names())
+    del entries
+    return {
+        "total": len(res.findings),
+        "new": len(res.new),
+        "by_rule": res.by_rule(),
+        "new_by_rule": res.new_by_rule(),
+        "entries": res.files,
+        "roster": n_roster,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _prometheus(res: LintResult, ir: bool = False) -> str:
     lines = [
         "# HELP dl4j_lint_findings_total graftlint findings by rule "
         "(baselined + new)",
@@ -76,9 +109,15 @@ def _prometheus(res: LintResult) -> str:
     for rule_id, n in sorted(res.new_by_rule().items()):
         lines.append(
             f'dl4j_lint_new_findings_total{{rule="{rule_id}"}} {n}')
-    lines.append("# HELP dl4j_lint_files_total files linted")
-    lines.append("# TYPE dl4j_lint_files_total gauge")
-    lines.append(f"dl4j_lint_files_total {res.files}")
+    if ir:
+        lines.append("# HELP dl4j_lint_ir_entries_total jit entry points "
+                     "abstract-evaled by the IR tier")
+        lines.append("# TYPE dl4j_lint_ir_entries_total gauge")
+        lines.append(f"dl4j_lint_ir_entries_total {res.files}")
+    else:
+        lines.append("# HELP dl4j_lint_files_total files linted")
+        lines.append("# TYPE dl4j_lint_files_total gauge")
+        lines.append(f"dl4j_lint_files_total {res.files}")
     return "\n".join(lines) + "\n"
 
 
@@ -98,6 +137,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="accept all current findings into the baseline")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the IR tier instead of the AST pass: "
+                         "trace/lower/compile the package's jit entry "
+                         "points on the virtual 8-device mesh and verify "
+                         "shardings, collectives and donation aliasing "
+                         "(requires jax; baseline section 'ir_findings')")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--metrics", action="store_true",
                     help="emit Prometheus text "
@@ -111,7 +156,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        # force registration
+        # force registration (ir registers rule ids only — no jax import)
+        from . import ir  # noqa: F401
         from . import rules_concurrency  # noqa: F401
         from . import rules_jit  # noqa: F401
         for rid, info in sorted(RULES.items()):
@@ -135,27 +181,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
     t0 = time.perf_counter()
-    try:
-        res = run_lint(args.paths, baseline_path=baseline_path, rules=rules)
-    except SyntaxError as e:
-        print(f"graftlint: {e}", file=sys.stderr)
-        return 2
+    if args.ir:
+        # the IR tier: probe-built jit entry points on the virtual mesh;
+        # `paths` only locate the baseline file. Imported lazily so the
+        # plain AST CLI keeps working in jax-free environments.
+        from .ir import IR_BASELINE_SECTION, run_ir_lint
+        try:
+            res = run_ir_lint(baseline_path=baseline_path, rules=rules)
+        except RuntimeError as e:      # 1-device backend: environment
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        section = IR_BASELINE_SECTION
+        unit = "entries"
+    else:
+        try:
+            res = run_lint(args.paths, baseline_path=baseline_path,
+                           rules=rules)
+        except SyntaxError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        section = "findings"
+        unit = "files"
+        if res.files == 0:
+            print("graftlint: no .py files found under "
+                  f"{', '.join(args.paths)}", file=sys.stderr)
+            return 2
     wall = time.perf_counter() - t0
-    if res.files == 0:
-        print("graftlint: no .py files found under "
-              f"{', '.join(args.paths)}", file=sys.stderr)
-        return 2
 
     if args.write_baseline:
         path = args.baseline or os.path.join(
             os.getcwd(), DEFAULT_BASELINE) if baseline_path is None \
             else baseline_path
-        write_baseline(path, res.findings)
-        print(f"graftlint: wrote {len(res.findings)} finding(s) to {path}")
+        write_baseline(path, res.findings, section=section)
+        print(f"graftlint: wrote {len(res.findings)} finding(s) to {path} "
+              f"[{section}]")
         return 0
 
     if args.metrics:
-        sys.stdout.write(_prometheus(res))
+        sys.stdout.write(_prometheus(res, ir=args.ir))
         return 0
 
     if args.format == "json":
@@ -173,7 +236,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f.render() + marker)
         for k in res.stale_baseline:
             print(f"stale baseline entry (no longer found): {k}")
-        summary = (f"graftlint: {res.files} files, "
+        summary = (f"graftlint: {res.files} {unit}, "
                    f"{len(res.findings)} finding(s) "
                    f"({len(res.findings) - len(res.new)} baselined, "
                    f"{len(res.new)} new), "

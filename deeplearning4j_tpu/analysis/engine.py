@@ -196,26 +196,38 @@ def load_project(paths: Sequence[str],
 # ---------------------------------------------------------------------------
 # Baseline workflow
 # ---------------------------------------------------------------------------
-def load_baseline(path: str) -> Dict[str, int]:
+# One baseline file holds one section per analysis tier: "findings" for
+# the AST pass, "ir_findings" for the jaxpr/HLO tier (ISSUE 13). Writing
+# one section must never clobber the other — each tier ratchets
+# independently.
+def load_baseline(path: str, section: str = "findings") -> Dict[str, int]:
     """{finding key: accepted count}. Missing file = empty baseline."""
     if not path or not os.path.exists(path):
         return {}
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
-    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+    return {str(k): int(v) for k, v in data.get(section, {}).items()}
 
 
-def write_baseline(path: str, findings: Sequence[Finding]):
+def write_baseline(path: str, findings: Sequence[Finding],
+                   section: str = "findings"):
     counts: Dict[str, int] = {}
     for f in findings:
         counts[f.key()] = counts.get(f.key(), 0) + 1
-    payload = {
-        "comment": "graftlint accepted-findings baseline. Keys are "
-                   "rule|file|scope|source-line (line-number free). "
-                   "Regenerate with: python -m tools.graftlint "
-                   "deeplearning4j_tpu/ --write-baseline",
-        "findings": {k: counts[k] for k in sorted(counts)},
-    }
+    payload = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {}
+    payload["comment"] = (
+        "graftlint accepted-findings baseline. Keys are "
+        "rule|file|scope|source-line (line-number free); 'findings' is "
+        "the AST pass, 'ir_findings' the jaxpr/HLO tier. Regenerate "
+        "with: python -m tools.graftlint deeplearning4j_tpu/ "
+        "--write-baseline [--ir]")
+    payload[section] = {k: counts[k] for k in sorted(counts)}
     with open(path, "w", encoding="utf-8", newline="\n") as f:
         json.dump(payload, f, indent=1, sort_keys=False)
         f.write("\n")

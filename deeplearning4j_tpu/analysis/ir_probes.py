@@ -1,0 +1,275 @@
+"""IR-tier probes: build real jit entry points for analysis/ir.py.
+
+The AST tier's `unwatched-jit-entry` rule drove the telemetry
+`watch_compiles` roster to 100% coverage of the package's jit entry
+points; these probes construct representatives of every entry-point
+FAMILY on the virtual 8-device mesh — tiny models (d=8 MLP, one-edge
+graph) so each trace+lower+compile is tens of milliseconds — and hand
+them to the IR rules with the metadata the rules diff against:
+
+  * the ZeRO step/superstep entries carry `parallel/zero.py`'s static
+    accounting (declared collective payload bytes, the declared
+    `with_sharding_constraint` schedule) and the bit-exactness the
+    equivalence suite asserts;
+  * the serving entries are the registry's AOT-compiled executables,
+    audited as compiled text (no re-lowering — what serves is what is
+    checked);
+  * everything else (single-device nn entries) is audited for donation
+    aliasing and schedule determinism.
+
+Tests reuse the builders here to seed mutations (drop a shard
+constraint, unorder the bucket flushes, donate an unaliasable buffer)
+and prove each rule fires.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .ir import IrEntry
+
+__all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
+           "parallel_entries", "zero_accum_entry", "serving_entries",
+           "virtual_mesh"]
+
+
+def virtual_mesh():
+    """The lint mesh: every local device on one `data` axis (8 under the
+    CI/CLI `--xla_force_host_platform_device_count=8` setup)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..parallel.mesh import MeshAxes
+
+    devs = np.array(jax.devices())
+    return Mesh(devs.reshape(devs.size), (MeshAxes.DATA,))
+
+
+def tiny_mlp(seed: int = 0):
+    """8->16->4 MLP with Adam — four param leaves, one of each shape
+    class (two matrices, two biases), enough for the ZeRO plan to have
+    sharded AND replicated leaves and >=2 gradient buckets at a small
+    bucket bound."""
+    from .. import (Adam, DenseLayer, InputType, MultiLayerNetwork,
+                    NeuralNetConfiguration, OutputLayer)
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(b: int = 16):
+    import jax.numpy as jnp
+    import numpy as np
+
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(b, 8)).astype(np.float32))
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[np.arange(b) % 4])
+    return x, y
+
+
+def nn_entries() -> List[IrEntry]:
+    """MultiLayerNetwork family: the per-batch train step (donates
+    params/state/opt), score, predict, and the accumulated superstep
+    (nested scan) — the single-device half of the roster."""
+    import jax
+    import jax.numpy as jnp
+
+    model = tiny_mlp()
+    x, y = _batch()
+    step = jnp.asarray(0, jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    p, s, o = model.params, model.state, model.updater_state
+    entries = [
+        IrEntry("nn/train_step", "nn/multilayer.py",
+                fn=model._train_step.__wrapped__,
+                args=(p, s, o, step, x, y, rng, None, None)),
+        IrEntry("nn/score", "nn/multilayer.py",
+                fn=model._score_fn.__wrapped__,
+                args=(p, s, x, y, None, None)),
+        IrEntry("nn/predict", "nn/multilayer.py",
+                fn=model._predict_fn.__wrapped__,
+                args=(p, s, x, None)),
+    ]
+    K, M, B = 2, 2, 8
+    xs = jnp.zeros((K, M, B, 8), jnp.float32)
+    ys = jnp.asarray(jnp.broadcast_to(
+        jnp.eye(4, dtype=jnp.float32)[jnp.arange(B) % 4], (K, M, B, 4)))
+    ones = jnp.ones((K, M, B), jnp.float32)
+    entries.append(IrEntry(
+        "nn/accum_superstep", "nn/superstep.py",
+        fn=model._accum_superstep_fn(False).__wrapped__,
+        args=(p, s, o, step, rng, xs, ys, ones, ones)))
+    entries.append(IrEntry(
+        "nn/superstep", "nn/superstep.py",
+        fn=model._superstep_fn.__wrapped__,
+        args=(p, s, o, step, rng, xs[:, 0], ys[:, 0], ones[:, 0],
+              ones[:, 0])))
+    return entries
+
+
+def graph_entries() -> List[IrEntry]:
+    """ComputationGraph family representative (the graph train step has
+    its own step builder and donation wiring)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import (Adam, DenseLayer, InputType, NeuralNetConfiguration,
+                    OutputLayer)
+    from ..nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="relu"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build())
+    model = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    x = {"in": jnp.asarray(r.normal(size=(16, 8)).astype(np.float32))}
+    y = {"out": jnp.asarray(np.eye(4, dtype=np.float32)[np.arange(16) % 4])}
+    return [IrEntry(
+        "graph/train_step", "nn/graph.py",
+        fn=model._train_step.__wrapped__,
+        args=(model.params, model.state, model.updater_state,
+              jnp.asarray(0, jnp.int32), x, y, jax.random.PRNGKey(0),
+              None, None))]
+
+
+def _trainer_entry(strategy, name: str, bucket_mb: Optional[float] = None
+                   ) -> IrEntry:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.trainer import ParallelTrainer
+
+    model = tiny_mlp()
+    kw = {} if bucket_mb is None else {"zero_bucket_mb": bucket_mb}
+    tr = ParallelTrainer(model, strategy=strategy, **kw)
+    x, y = _batch()
+    info = tr.collective_accounting()
+    entry = IrEntry(
+        name, "parallel/zero.py" if info else "parallel/trainer.py",
+        fn=tr._step_fn.__wrapped__,
+        args=(tr._params, tr._state, tr._opt, jnp.asarray(0, jnp.int32),
+              x, y, jax.random.PRNGKey(0), None, None),
+        mesh_axes=tuple(tr.mesh.axis_names),
+        asserts_bitexact=True)   # tests/test_zero.py asserts replicated==zero
+    if info:
+        entry.declared_bytes = sum(info["bytes"].values())
+        entry.check_bytes = True           # scan-free: text == per-step
+        entry.expected_constraints = info.get("expected_constraints")
+    return entry
+
+
+def parallel_entries() -> List[IrEntry]:
+    """ParallelTrainer family on the virtual mesh: the SYNC replicated,
+    ZeRO-1 and ZeRO-2 per-batch steps (each carrying its declared static
+    accounting where the strategy publishes one) plus the AVERAGING
+    shard_map local step."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.trainer import (ParallelTrainer, ShardingStrategy,
+                                    TrainingMode)
+
+    entries = [
+        _trainer_entry(ShardingStrategy.REPLICATED, "parallel/train_step"),
+        _trainer_entry(ShardingStrategy.ZERO1, "parallel/zero1_step"),
+        _trainer_entry(ShardingStrategy.ZERO2, "parallel/zero2_step",
+                       bucket_mb=0.0005),
+    ]
+    tr = ParallelTrainer(tiny_mlp(), mode=TrainingMode.AVERAGING)
+    n = tr.n_data
+    x, y = _batch(16)
+    resh = lambda a: jnp.reshape(a, (n, -1) + a.shape[1:])
+    entries.append(IrEntry(
+        "parallel/local_step", "parallel/trainer.py",
+        fn=tr._local_step.__wrapped__,
+        args=(tr._params, tr._state, tr._opt, jnp.asarray(0, jnp.int32),
+              resh(x), resh(y), None, None, jax.random.PRNGKey(0)),
+        mesh_axes=tuple(tr.mesh.axis_names)))
+    return entries
+
+
+def zero_accum_entry(stage: int = 2, bucket_mb: float = 0.0005,
+                     ordered_flush: bool = True, model=None,
+                     K: int = 2, M: int = 2, B: int = 16) -> IrEntry:
+    """The ZeRO accumulated superstep (nested scan, barrier-token-ordered
+    bucket flushes, sharded fp32 accumulators) jitted exactly as
+    ParallelTrainer jits it. Public so tests can seed mutations through
+    the same builder (ordered_flush=False, monkeypatched constraints)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MeshAxes
+    from ..parallel.zero import (ZeroConfig, make_zero_accum_superstep,
+                                 zero_opt_shardings)
+
+    from ..telemetry.compile_watch import watch_compiles
+
+    model = model if model is not None else tiny_mlp()
+    mesh = virtual_mesh()
+    cfg = ZeroConfig(stage=stage, bucket_mb=bucket_mb,
+                     ordered_flush=ordered_flush)
+    fn, info = make_zero_accum_superstep(model, mesh, config=cfg)
+    repl = NamedSharding(mesh, P())
+    win = NamedSharding(mesh, P(None, None, MeshAxes.DATA))
+    o_sh = zero_opt_shardings(model.updater_state, model.params, mesh,
+                              MeshAxes.DATA)
+    jitted = watch_compiles(jax.jit(
+        fn,
+        in_shardings=(repl, repl, o_sh, repl, repl, win, win, win, win),
+        out_shardings=(repl, repl, o_sh, repl, repl, repl),
+        donate_argnums=(0, 1, 2)),
+        f"analysis/ir_probe:zero{stage}_accum_superstep").__wrapped__
+    xs = jnp.zeros((K, M, B, 8), jnp.float32)
+    ys = jnp.asarray(jnp.broadcast_to(
+        jnp.eye(4, dtype=jnp.float32)[jnp.arange(B) % 4], (K, M, B, 4)))
+    ones = jnp.ones((K, M, B), jnp.float32)
+    return IrEntry(
+        f"parallel/zero{stage}_accum_superstep", "parallel/zero.py",
+        fn=jitted,
+        args=(model.params, model.state, model.updater_state,
+              jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+              xs, ys, ones, ones),
+        mesh_axes=tuple(mesh.axis_names),
+        expected_constraints=info.get("expected_constraints"),
+        requires_ordered_reductions=(stage >= 2
+                                     and info.get("n_buckets", 0) >= 2),
+        asserts_bitexact=True)
+
+
+def serving_entries() -> List[IrEntry]:
+    """The serving plane's AOT executables: register a tiny model, then
+    audit exactly the compiled runners request threads will invoke."""
+    from ..serving.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    reg.register("ir-probe", tiny_mlp(), buckets=(8,))
+    return [IrEntry(f"serving/aot:{name}:b{bucket}", "serving/registry.py",
+                    compiled=co)
+            for name, bucket, co in reg.aot_executables()]
+
+
+def build_entries() -> List[IrEntry]:
+    """The full IR roster, in deterministic order. Every entry family the
+    package registers through watch_compiles/record_aot is represented;
+    the self-host gate (tests/test_analysis.py) runs these against the
+    `ir_findings` baseline section."""
+    entries: List[IrEntry] = []
+    entries += nn_entries()
+    entries += graph_entries()
+    entries += parallel_entries()
+    entries.append(zero_accum_entry())
+    entries += serving_entries()
+    return entries

@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["sanitize", "SanitizerReport", "ThreadLeakError",
            "LockOrderError", "OrderCheckedLock", "LockOrderWatch",
-           "wrap_lock_attrs"]
+           "wrap_lock_attrs", "CollectiveSequenceHasher",
+           "current_collective_hasher", "collective_hashes_agree"]
 
 
 class ThreadLeakError(AssertionError):
@@ -57,6 +58,83 @@ class SanitizerReport:
     lock_violations: List[str] = field(default_factory=list)
     checked_locks: int = 0
     started_threads: int = 0
+    # filled by sanitize(collective_hash=True): one digest per training
+    # step observed inside the block, plus the whole-block digest
+    collective_step_digests: List[str] = field(default_factory=list)
+    collective_digest: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Per-step collective-sequence hash (the runtime half of the IR tier's
+# collective-order check — analysis/ir.py owns the static half and the
+# shared digest format)
+# ---------------------------------------------------------------------------
+class CollectiveSequenceHasher:
+    """Hashes the sequence of collectives a process ISSUES per training
+    step. ParallelTrainer feeds it from the step's static accounting
+    (op, logical payload bytes, multiplicity) in issue order; `end_step`
+    closes one step's digest. The invariant under test: every process in
+    a multi-host mesh must produce the IDENTICAL digest stream — a
+    divergence (stale ZeRO plan after an elastic resize, mismatched
+    bucket layout, a worker running a different step ordinal) is visible
+    in a log line instead of a silent deadlock inside the mismatched
+    collective. Item 4's kill/rejoin drills run under this hook via
+    `sanitize(collective_hash=True)`."""
+
+    def __init__(self):
+        import hashlib
+        self._hashlib = hashlib
+        self._lock = threading.Lock()
+        self._step = hashlib.sha256()
+        self._step_len = 0
+        self.step_digests: List[str] = []
+
+    def record(self, op: str, nbytes: int, n: int = 1):
+        """One collective issue: `op` moving `nbytes` logical payload
+        (`n` = multiplicity, e.g. bucket flushes per reduce-scatter)."""
+        with self._lock:
+            self._step.update(f"{op}:{int(nbytes)}:{int(n)}\0".encode())
+            self._step_len += 1
+
+    def end_step(self):
+        with self._lock:
+            if self._step_len == 0:
+                return
+            self.step_digests.append(self._step.hexdigest()[:16])
+            self._step = self._hashlib.sha256()
+            self._step_len = 0
+
+    def digest(self) -> str:
+        """Digest of the whole per-step digest stream — the one value
+        processes exchange to compare runs."""
+        h = self._hashlib.sha256()
+        with self._lock:
+            for d in self.step_digests:
+                h.update(d.encode())
+        return h.hexdigest()[:16]
+
+
+_collective_hasher: Optional[CollectiveSequenceHasher] = None
+
+
+def current_collective_hasher() -> Optional[CollectiveSequenceHasher]:
+    return _collective_hasher
+
+
+def collective_hashes_agree(hasher: CollectiveSequenceHasher) -> bool:
+    """Multi-process agreement check: allgather every process's stream
+    digest and compare. True on a single process. Safe to call from all
+    processes simultaneously (it IS a collective)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return True
+    from jax.experimental import multihost_utils as mhu
+    import numpy as np
+
+    mine = int(hasher.digest(), 16) % (2 ** 63)
+    got = np.asarray(mhu.process_allgather(np.asarray([mine])))
+    return bool((got == got.flat[0]).all())
 
 
 # ---------------------------------------------------------------------------
@@ -235,6 +313,7 @@ def _thread_leaks(before: set, grace_s: float,
 @contextlib.contextmanager
 def sanitize(tracer_leaks: bool = False, debug_nans: bool = False,
              thread_watchdog: bool = True, lock_order: bool = True,
+             collective_hash: bool = False,
              grace_s: float = 5.0,
              allow_threads: Sequence[str] = (),
              raise_on_violation: bool = True):
@@ -242,9 +321,17 @@ def sanitize(tracer_leaks: bool = False, debug_nans: bool = False,
     SanitizerReport filled in at exit. `allow_threads` name-substrings
     are ADDED to the built-in allowlist (tooling/jax pools) — use it for
     threads owned by longer-lived fixtures that legitimately outlive one
-    sanitized block. See module docstring."""
+    sanitized block. `collective_hash=True` installs the per-step
+    collective-sequence hasher (see CollectiveSequenceHasher); the
+    report carries the per-step digests at exit. See module docstring."""
+    global _collective_hasher
     report = SanitizerReport()
     allow_threads = tuple(_DEFAULT_ALLOW) + tuple(allow_threads)
+    hasher = prev_hasher = None
+    if collective_hash:
+        hasher = CollectiveSequenceHasher()
+        prev_hasher = _collective_hasher
+        _collective_hasher = hasher
     jax_restore = []
     if tracer_leaks or debug_nans:
         import jax
@@ -261,6 +348,10 @@ def sanitize(tracer_leaks: bool = False, debug_nans: bool = False,
         with ctx:
             yield report
     finally:
+        if hasher is not None:
+            _collective_hasher = prev_hasher
+            report.collective_step_digests = list(hasher.step_digests)
+            report.collective_digest = hasher.digest()
         if jax_restore:
             import jax
             for flag, old in jax_restore:

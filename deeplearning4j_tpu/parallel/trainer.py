@@ -665,6 +665,10 @@ class ParallelTrainer:
                 self._score = score
                 if tel is not None and self._zero_info is not None:
                     self._record_zero_metrics(tel)
+                else:
+                    # no telemetry session: the sanitizer's collective
+                    # hasher (if installed) still observes the schedule
+                    self._feed_collective_hasher()
                 if self.stats is not None or (tel is not None
                                               and tel.sync_per_step):
                     with span("device/sync"):
@@ -696,7 +700,8 @@ class ParallelTrainer:
             # per-device watermarks over THIS trainer's mesh
             tel.watermarks.sample(devices=list(self.mesh.devices.flat))
 
-    def _record_zero_metrics(self, tel, n_micro: int = 1, n_steps: int = 1):
+    def _record_zero_metrics(self, tel, n_micro: int = 1, n_steps: int = 1,
+                             micro_m: Optional[int] = None):
         """ZeRO collective-traffic counters (static accounting from
         make_zero_step / make_zero_accum_superstep):
           dl4j_collective_bytes_total{op}   logical payload bytes by
@@ -727,6 +732,60 @@ class ParallelTrainer:
                 c_bytes.inc(b * mult, op=op)
         if info["n_buckets"] and n_micro:
             c_flush.inc(info["n_buckets"] * n_micro)
+        self._feed_collective_hasher(n_micro, n_steps, micro_m=micro_m)
+
+    def collective_accounting(self):
+        """The step's declared static collective accounting (a copy of
+        `parallel/zero.py`'s plan info: logical payload bytes by op,
+        bucket count, the `with_sharding_constraint` schedule) — what
+        telemetry counters AND the graftlint IR tier diff the compiled
+        program against (analysis/ir.py `ir-implicit-reshard`). None for
+        strategies that publish no accounting (replicated/averaging)."""
+        return dict(self._zero_info) if self._zero_info else None
+
+    def _feed_collective_hasher(self, n_micro: int = 1, n_steps: int = 1,
+                                micro_m: Optional[int] = None):
+        """Per-step collective-sequence hash (the runtime half of the IR
+        tier's order check): when a sanitizer hasher is installed, record
+        the issue schedule of each of the `n_steps` OPTIMIZER steps that
+        just ran (a superstep window dispatches several at once) — per
+        microbatch the bucketed reduce-scatter flushes, then the
+        step-level reductions and the param allgather — closing one
+        digest per optimizer step, so a K-step window and K per-batch
+        steps produce the identical digest stream. Item 4's kill/rejoin
+        drills compare the per-process streams; a worker whose plan or
+        bucket layout diverged after an elastic resize hashes differently
+        BEFORE it deadlocks the mesh inside a mismatched collective."""
+        from ..analysis.sanitizer import current_collective_hasher
+
+        h = current_collective_hasher()
+        if h is None or self._zero_info is None:
+            return
+        info = self._zero_info
+        rs, nb = info["bytes"].get("reduce_scatter", 0), info["n_buckets"]
+        n_micro = max(1, int(n_micro))
+        if micro_m is not None:
+            # the window's ACTUAL per-step grouping: full groups of m,
+            # then the ragged tail — dispatch_accum_groups' segmentation
+            # ([m]*q + [r]), which a ceil-split reconstruction would
+            # misreport for ragged windows (e.g. 9 micro at m=4 dispatch
+            # as [4,4,1], not [3,3,3])
+            m = max(1, int(micro_m))
+            counts = [m] * (n_micro // m)
+            if n_micro % m:
+                counts.append(n_micro % m)
+        else:
+            n_steps = max(1, int(n_steps))
+            m = -(-n_micro // n_steps)
+            counts = [m] * (n_steps - 1) + [n_micro - m * (n_steps - 1)]
+        for count in counts:
+            for _ in range(count if rs else 0):
+                h.record("reduce_scatter", rs, n=max(1, nb))
+            for op in ("all_reduce", "all_gather"):
+                b = info["bytes"].get(op, 0)
+                if b:
+                    h.record(op, b)
+            h.end_step()
 
     @property
     def params_replicated(self) -> bool:
@@ -1252,11 +1311,16 @@ class _TrainerSuperstepAdapter:
         n_steps = steps_in(n, self.m)
         tel = _tel_active()
         if tel is None:
+            # the sanitizer's collective hasher (if installed) observes
+            # the window's schedule even without a telemetry session
+            tr._feed_collective_hasher(n_micro=n, n_steps=n_steps,
+                                       micro_m=self.m)
             return
         if tr._zero_info is not None:
             # static accounting scales over the window: reduce-scatter per
             # microbatch, all-reduce/allgather per optimizer step
-            tr._record_zero_metrics(tel, n_micro=n, n_steps=n_steps)
+            tr._record_zero_metrics(tel, n_micro=n, n_steps=n_steps,
+                                    micro_m=self.m)
         w = tel.report_window
         if (tr.iteration_count + n_steps) // w > tr.iteration_count // w:
             tel.watermarks.sample(devices=list(tr.mesh.devices.flat))

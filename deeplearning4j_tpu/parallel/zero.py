@@ -249,6 +249,34 @@ class _ZeroPlan:
         self.opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
         self.opt_treedef = jax.tree_util.tree_structure(model.updater_state)
 
+    def expected_constraints(self, accum: bool = False) -> int:
+        """The number of `with_sharding_constraint` applications the plan
+        emits into ONE trace of its step — the static layout CONTRACT the
+        IR lint tier (analysis/ir.py) checks the traced jaxpr against. A
+        count below this means a shard constraint was dropped somewhere
+        in zero.py: XLA's sharding propagation is then unconstrained and
+        free to materialize a replicated copy of a ZeRO shard. Keep this
+        formula in sync when adding/removing constraint sites (the IR
+        self-host gate in tests/test_analysis.py enforces agreement).
+
+        Sites (scan bodies trace once):
+          * reduce_scatter: one constraint per SHARDED leaf (stage 2)
+          * constrain_params / constrain_acc: sharded leaves each
+          * constrain_opt: every optimizer-state leaf
+          * accum superstep adds: acc0 init + per-microbatch accumulator
+            + gradient-mean (stage 2), each over the sharded leaves
+        """
+        n_sharded = len(self.sharded_set)
+        n_opt = len(self.opt_sh_leaves)
+        stage2 = self.config.stage >= 2
+        count = n_sharded + n_opt            # constrain_params + opt
+        if stage2:
+            count += n_sharded               # reduce_scatter
+        if accum and stage2:
+            # acc0, per-micro accumulator, gmean (constrain_acc x3)
+            count += 3 * n_sharded
+        return count
+
     # ---- the gradient reduction (stage 2): bucketed reduce-scatter ------
     def reduce_scatter(self, grads, token=None):
         """Bucketed reduce-scatter of a gradient tree. `token` chains the
@@ -323,6 +351,7 @@ def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
     gradient bucket count.
     """
     plan = _ZeroPlan(model, mesh, data_axis, config)
+    plan.info["expected_constraints"] = plan.expected_constraints()
     # the model's grad half (loss selection incl. remat + minimize sign)
     grad_fn = model.grad_step_fn
 
@@ -377,6 +406,7 @@ def make_zero_accum_superstep(model, mesh: Mesh, *,
     renormalize over the finite ones).
     """
     plan = _ZeroPlan(model, mesh, data_axis, config)
+    plan.info["expected_constraints"] = plan.expected_constraints(accum=True)
     grad_fn = model.grad_step_fn
     stage2 = config.stage >= 2
 

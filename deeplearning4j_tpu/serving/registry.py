@@ -295,6 +295,24 @@ class ModelRegistry:
         with self._lock:
             self._entries.pop(name, None)
 
+    def aot_executables(self):
+        """Snapshot of every live AOT-compiled executable as
+        (model name, batch bucket, compiled) tuples — the graftlint IR
+        tier (analysis/ir.py) audits exactly these: what serves is what
+        is checked (collective schedule, buffer aliasing), not a
+        re-lowered approximation."""
+        with self._lock:
+            entries = list(self._entries.items())
+        out = []
+        for name, entry in entries:
+            with entry.swap_lock:
+                version = entry.current
+                if version is None:
+                    continue
+                for bucket in version.buckets:
+                    out.append((name, bucket, version.runners[bucket]))
+        return out
+
     # -- lookup ---------------------------------------------------------
     def _current(self, name: str) -> Optional[ServableVersion]:
         with self._lock:

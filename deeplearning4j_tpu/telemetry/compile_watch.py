@@ -17,9 +17,43 @@ from __future__ import annotations
 import threading
 import time
 import warnings
-from typing import Callable, Dict
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["CompileWatcher", "watch_compiles", "RecompilationStormWarning"]
+__all__ = ["CompileWatcher", "watch_compiles", "RecompilationStormWarning",
+           "roster", "roster_names"]
+
+# Wrap-time roster of every watch_compiles-registered jit entry point:
+# name -> weakref to the underlying jitted callable. This is the coverage
+# ledger the `unwatched-jit-entry` lint rule drove to 100% — the IR lint
+# tier (analysis/ir.py) uses it to enumerate the entry points it
+# abstract-evals, and --metrics reports its size as the coverage
+# denominator. Weak references: a wrapped step dies with its model, the
+# roster must not keep retraced closures (and their captured params)
+# alive.
+_ROSTER: Dict[str, "weakref.ref"] = {}
+_ROSTER_LOCK = threading.Lock()
+
+
+def roster() -> List[Tuple[str, Callable]]:
+    """Live (name, jitted fn) pairs currently registered, sorted by name.
+    Entries whose function was garbage-collected are pruned."""
+    out = []
+    with _ROSTER_LOCK:
+        dead = []
+        for name, ref in _ROSTER.items():
+            fn = ref()
+            if fn is None:
+                dead.append(name)
+            else:
+                out.append((name, fn))
+        for name in dead:
+            del _ROSTER[name]
+    return sorted(out, key=lambda p: p[0])
+
+
+def roster_names() -> List[str]:
+    return [name for name, _ in roster()]
 
 
 class RecompilationStormWarning(RuntimeWarning):
@@ -146,8 +180,18 @@ class CompileWatcher:
 
 def watch_compiles(fn: Callable, name: str) -> Callable:
     """Wrap a jitted callable so the ACTIVE telemetry session (if any)
-    observes its compilations. Disabled cost: one global read per call."""
+    observes its compilations. Disabled cost: one global read per call.
+    Wrapping also registers `name` in the module roster (latest wrap
+    wins — a model rebuilding its step re-registers the same name)."""
     from . import runtime
+
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:       # non-weakrefable callable: skip the roster
+        ref = None
+    if ref is not None:
+        with _ROSTER_LOCK:
+            _ROSTER[name] = ref
 
     def watched(*args, **kwargs):
         tel = runtime.active()

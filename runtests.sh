@@ -33,18 +33,25 @@
 #                            accum-vs-native bench rep on the 8-dev mesh
 #                            (throughput ratio, accumulator memory,
 #                            overlap fraction)
-#   ./runtests.sh lint       graftlint static pass (jit/tracer hygiene,
-#                            recompile hazards, donation safety,
-#                            concurrency lint) against the checked-in
+#   ./runtests.sh lint       graftlint, both tiers: the AST pass
+#                            (jit/tracer hygiene, recompile hazards,
+#                            donation safety, concurrency lint) AND the
+#                            IR pass (trace/lower/compile every probe-
+#                            built jit entry point on the virtual
+#                            8-device mesh; sharding, collective-order,
+#                            donation-aliasing and reduction-determinism
+#                            verification) against the checked-in
 #                            baseline — any NON-baselined finding fails —
 #                            plus the analysis self-tests and runtime-
-#                            sanitizer smoke. The same gate runs inside
+#                            sanitizer smoke. The same gates run inside
 #                            the full suite via tests/test_analysis.py.
 set -euo pipefail
 cd "$(dirname "$0")"
 if [[ "${1:-}" == "lint" ]]; then
-    echo "=== graftlint static pass (baseline: graftlint_baseline.json) ==="
+    echo "=== graftlint AST pass (baseline: graftlint_baseline.json) ==="
     python -m tools.graftlint deeplearning4j_tpu/
+    echo "=== graftlint IR pass (virtual 8-device mesh, ir_findings) ==="
+    env JAX_PLATFORMS=cpu python -m tools.graftlint deeplearning4j_tpu/ --ir
     echo "=== analysis self-tests + runtime sanitizer smoke ==="
     exec python -m pytest tests/test_analysis.py -q
 fi

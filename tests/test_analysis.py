@@ -899,3 +899,337 @@ def test_remote_router_put_update_never_blocks_behind_slow_drain():
     assert posted == ["w1", "w2"]        # order preserved, both delivered
     res = run_lint([os.path.join(PKG, "ui", "remote.py")])
     assert "blocking-call-under-lock" not in rules_of(res)
+
+
+# ---------------------------------------------------------------------------
+# IR tier (ISSUE 13): jaxpr/HLO verification of jit entry points
+# ---------------------------------------------------------------------------
+def _ir():
+    from deeplearning4j_tpu.analysis import ir
+    return ir
+
+
+def _probes():
+    from deeplearning4j_tpu.analysis import ir_probes
+    return ir_probes
+
+
+def _zero_mod():
+    from deeplearning4j_tpu.parallel import zero
+    return zero
+
+
+def test_ir_selfhost_clean_under_60s():
+    """The IR-tier CI gate: every probe-built jit entry point (both model
+    families, replicated/ZeRO-1/ZeRO-2 trainer steps, the ZeRO accum
+    superstep, serving's AOT executables) traces, lowers and compiles on
+    the virtual 8-device mesh and comes in clean against the
+    `ir_findings` baseline section."""
+    ir = _ir()
+    t0 = time.perf_counter()
+    entries = _probes().build_entries()
+    res = ir.run_ir_lint(entries, baseline_path=BASELINE)
+    wall = time.perf_counter() - t0
+    assert wall < 60.0, f"IR pass took {wall:.1f}s"
+    assert res.files >= 8, f"only {res.files} IR entries probed"
+    msg = "\n".join(f.render() for f in res.new)
+    assert not res.new, f"new IR findings (fix or baseline):\n{msg}"
+    # the roster ledger saw the probes' entry points (weakrefs stay live
+    # while `entries` holds the jitted fns)
+    from deeplearning4j_tpu.telemetry.compile_watch import roster_names
+    assert {"nn/train_step", "parallel/zero_step"} <= set(roster_names())
+    del entries
+
+
+def test_ir_dropped_shard_constraint_caught(monkeypatch):
+    """Seeded mutation (acceptance): drop a `with_sharding_constraint`
+    in zero.py — the traced program then carries fewer constraints than
+    the plan's declared layout schedule and ir-implicit-reshard fires."""
+    ir, probes, zmod = _ir(), _probes(), _zero_mod()
+    monkeypatch.setattr(zmod._ZeroPlan, "constrain_params",
+                        lambda self, t: t)
+    from deeplearning4j_tpu.parallel.trainer import ShardingStrategy
+    entry = probes._trainer_entry(ShardingStrategy.ZERO2,
+                                  "parallel/zero2_step", bucket_mb=0.0005)
+    found = ir.analyze_entry(entry)
+    hits = [f for f in found if f.rule == "ir-implicit-reshard"
+            and f.snippet.endswith(":constraints")]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "dropped" in hits[0].message
+
+
+def test_ir_implicit_gspmd_reshard_caught(monkeypatch):
+    """Seeded mutation (acceptance): a ZeRO shard accidentally
+    materialized REPLICATED (the classic silent GSPMD reshard) — the
+    compiled program's collective bytes blow past the step's declared
+    static accounting and ir-implicit-reshard fires."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ir, probes, zmod = _ir(), _probes(), _zero_mod()
+    orig = zmod._ZeroPlan.constrain_opt
+
+    def replicate_first(self, tree):
+        mesh = probes.virtual_mesh()
+        tree = jax.tree_util.tree_map(
+            lambda v: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P())), tree)
+        return orig(self, tree)
+
+    monkeypatch.setattr(zmod._ZeroPlan, "constrain_opt", replicate_first)
+    from deeplearning4j_tpu.parallel.trainer import ShardingStrategy
+    entry = probes._trainer_entry(ShardingStrategy.ZERO2,
+                                  "parallel/zero2_step", bucket_mb=0.0005)
+    found = ir.analyze_entry(entry)
+    hits = [f for f in found if f.rule == "ir-implicit-reshard"
+            and f.snippet.endswith(":bytes")]
+    assert len(hits) == 1, [f.render() for f in found]
+    assert "declared" in hits[0].message
+
+
+def test_ir_unaliased_donation_caught_and_clean_quiet():
+    """Seeded mutation (acceptance): donate a buffer XLA cannot alias
+    (dtype matches no output) -> ir-ineffective-donation; the same shape
+    with a matching output stays quiet."""
+    import jax
+    import jax.numpy as jnp
+
+    ir = _ir()
+
+    def bad(p, x):
+        return (p * 2).astype(jnp.bfloat16), jnp.sum(x)
+
+    def good(p, x):
+        return p * 2, jnp.sum(x)
+
+    z = jnp.zeros(32, jnp.float32)
+    fired = ir.analyze_entry(ir.IrEntry(
+        "test/unaliased", "test.py",
+        fn=jax.jit(bad, donate_argnums=(0,)), args=(z, z)))
+    assert [f.rule for f in fired] == ["ir-ineffective-donation"]
+    quiet = ir.analyze_entry(ir.IrEntry(
+        "test/aliased", "test.py",
+        fn=jax.jit(good, donate_argnums=(0,)), args=(z, z)))
+    assert not [f for f in quiet if f.rule == "ir-ineffective-donation"]
+    # review regression: donation attribute on a NON-leading arg must be
+    # attributed to that arg, not smeared onto earlier args by a
+    # span-crossing match — donate_argnums=(1,) is aliased and quiet
+    def good_second(x, p):
+        return p * 2, jnp.sum(x)
+
+    jitted = jax.jit(good_second, donate_argnums=(1,))
+    lowered = jitted.trace(z, z).lower()
+    assert ir.donated_params(lowered.as_text()) == {1}
+    quiet2 = ir.analyze_entry(ir.IrEntry(
+        "test/aliased-second", "test.py", fn=jitted, args=(z, z)))
+    assert not [f for f in quiet2 if f.rule == "ir-ineffective-donation"]
+
+
+def test_ir_collective_order_divergence_caught():
+    """Seeded mutation (acceptance): two per-process programs issuing
+    the same collectives in different order — the divergence the elastic
+    resize drills must never produce. The same digest format serves the
+    static pass, per-process program texts, and the runtime hasher."""
+    ir = _ir()
+    seq_a = [("all-reduce", "f32[64]", "[1,8]<=[8]"),
+             ("all-gather", "f32[64]", "[1,8]<=[8]")]
+    seq_b = list(reversed(seq_a))
+    msg = ir.check_cross_program_order([seq_a, seq_b])
+    assert msg is not None and "diverges at collective 0" in msg
+    assert ir.check_cross_program_order([seq_a, list(seq_a)]) is None
+    assert ir.sequence_digest(seq_a) != ir.sequence_digest(seq_b)
+    assert ir.sequence_digest(seq_a) == ir.sequence_digest(tuple(seq_a))
+    # truncated program (a process that lost a collective entirely)
+    msg2 = ir.check_cross_program_order([seq_a, seq_a[:1]])
+    assert msg2 is not None and "issues 1 collectives" in msg2
+
+
+def test_ir_nondeterministic_reduction_caught():
+    """Seeded mutation: ZeroConfig(ordered_flush=False) removes the
+    optimization_barrier token chain from the accum superstep — a
+    bit-exact-asserted entry with unordered bucketed float reductions
+    must trip ir-nondeterministic-reduction (the ordered default stays
+    quiet via the self-host gate)."""
+    ir, probes = _ir(), _probes()
+    entry = probes.zero_accum_entry(ordered_flush=False)
+    found = ir.analyze_entry(entry)
+    assert "ir-nondeterministic-reduction" in {f.rule for f in found}, \
+        [f.render() for f in found]
+
+
+def test_ir_redundant_reshard_and_invalid_axis_caught():
+    """psum_scatter immediately all-gathered back fires the redundant-
+    reshard pair rule (jaxpr AND compiled-text detectors); a collective
+    over an axis the entry's mesh does not define fires ir-invalid-axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.compat import shard_map
+
+    ir, probes = _ir(), _probes()
+    mesh = probes.virtual_mesh()
+
+    def body(x):
+        s = jax.lax.psum_scatter(x, "data", scatter_dimension=0, tiled=True)
+        return jax.lax.all_gather(s, "data", axis=0, tiled=True)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data")))
+    z = jnp.zeros(64, jnp.float32)
+    found = ir.analyze_entry(ir.IrEntry(
+        "test/reshard", "test.py", fn=fn, args=(z,), mesh_axes=("data",)))
+    assert "ir-redundant-reshard" in {f.rule for f in found}
+    found2 = ir.analyze_entry(ir.IrEntry(
+        "test/axis", "test.py", fn=fn, args=(z,), mesh_axes=("model",)))
+    assert "ir-invalid-axis" in {f.rule for f in found2}
+
+
+def test_ir_async_collective_pairs_counted_once():
+    """Review regression: async backends emit -start/-done pairs for one
+    collective — the sequence and byte accounting must count the pair
+    once (at -start), or every async collective doubles the measured
+    payload and trips the byte budget spuriously."""
+    ir = _ir()
+    text = (
+        "  %ar = f32[64]{0} all-reduce-start(f32[64]{0} %p0), "
+        "channel_id=1, replica_groups=[1,8]<=[8]\n"
+        "  %ard = f32[64]{0} all-reduce-done(f32[64]{0} %ar)\n"
+        "  %ag = f32[128]{0} all-gather(f32[16]{0} %x), channel_id=2, "
+        "replica_groups=[1,8]<=[8], dimensions={0}\n")
+    seq = ir.collective_sequence(text)
+    assert [op for op, _, _ in seq] == ["all-reduce", "all-gather"]
+    bytes_by_op = ir.measured_collective_bytes(text)
+    assert bytes_by_op == {"all-reduce": 256, "all-gather": 512}
+
+
+def test_ir_single_device_backend_refused():
+    """Review regression: on a 1-device backend the virtual mesh
+    degenerates and a 'clean' IR run verifies nothing — run_ir_lint must
+    refuse loudly (and the CLI turn it into exit 2), never exit 0."""
+    import subprocess
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys; sys.path.insert(0, %r)\n"
+        "assert jax.device_count() == 1, jax.device_count()\n"
+        "from deeplearning4j_tpu.analysis.ir import run_ir_lint\n"
+        "try:\n"
+        "    run_ir_lint(entries=[])\n"
+        "except RuntimeError as e:\n"
+        "    assert 'multi-device' in str(e), e\n"
+        "else:\n"
+        "    raise SystemExit('run_ir_lint accepted a 1-device backend')\n"
+        "from deeplearning4j_tpu.analysis.cli import main\n"
+        "rc = main([%r, '--ir'])\n"
+        "assert rc == 2, rc\n" % (REPO, PKG))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_ir_baseline_section_roundtrip(tmp_path):
+    """The `ir_findings` baseline section ratchets independently of the
+    AST section: writing one never clobbers the other, and a baselined
+    IR finding stops failing the run."""
+    ir = _ir()
+    bl = tmp_path / "bl.json"
+    ast_finding = Finding("jit-in-loop", "a.py", 1, 0, "m", scope="s",
+                          snippet="jax.jit(f)")
+    write_baseline(str(bl), [ast_finding])                  # AST section
+    ir_finding = ir.IrEntry("e", "p.py").finding(
+        "ir-implicit-reshard", "msg", "bytes")
+    write_baseline(str(bl), [ir_finding], section=ir.IR_BASELINE_SECTION)
+    assert load_baseline(str(bl)) == {ast_finding.key(): 1}  # preserved
+    assert load_baseline(str(bl), section=ir.IR_BASELINE_SECTION) == {
+        ir_finding.key(): 1}
+    res = ir.run_ir_lint(entries=[], baseline_path=str(bl))
+    assert not res.new and res.stale_baseline == [ir_finding.key()]
+
+
+def test_cli_ir_exit_codes(monkeypatch):
+    """`--ir` exit-code contract: 0 on the clean roster, 1 when a seeded
+    zero.py mutation introduces a non-baselined IR finding."""
+    import contextlib
+    import io
+
+    from deeplearning4j_tpu.analysis.cli import main
+
+    zmod = _zero_mod()
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert main([PKG, "--ir", "--baseline", BASELINE]) == 0
+    monkeypatch.setattr(zmod._ZeroPlan, "constrain_params",
+                        lambda self, t: t)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main([PKG, "--ir", "--baseline", BASELINE]) == 1
+    assert "ir-implicit-reshard" in buf.getvalue()
+
+
+def test_cli_ir_metrics_mode():
+    from deeplearning4j_tpu.analysis.cli import ir_lint_metrics
+
+    m = ir_lint_metrics([PKG], baseline=BASELINE)
+    assert m["new"] == 0 and m["entries"] >= 8 and m["wall_s"] > 0
+    assert m["roster"] >= 2      # watch_compiles ledger populated
+
+
+# ---------------------------------------------------------------------------
+# Runtime collective-sequence hash (the dynamic half of the order check)
+# ---------------------------------------------------------------------------
+def test_collective_hasher_digests():
+    from deeplearning4j_tpu.analysis.sanitizer import (
+        CollectiveSequenceHasher, collective_hashes_agree)
+
+    a, b, c = (CollectiveSequenceHasher() for _ in range(3))
+    for h in (a, b):
+        h.record("reduce_scatter", 832, n=2)
+        h.record("all_gather", 832)
+        h.end_step()
+    c.record("all_gather", 832)              # different issue order
+    c.record("reduce_scatter", 832, n=2)
+    c.end_step()
+    assert a.step_digests == b.step_digests
+    assert a.digest() == b.digest()
+    assert a.step_digests != c.step_digests
+    assert a.digest() != c.digest()
+    # empty steps do not emit digests
+    a.end_step()
+    assert len(a.step_digests) == 1
+    assert collective_hashes_agree(a)        # single-process: trivially true
+
+
+@pytest.mark.sanitize(collective_hash=True, lock_order=False)
+def test_collective_hash_hook_observes_zero_training(request):
+    """sanitize(collective_hash=True) + a ZeRO-2 trainer fit: every
+    optimizer step hashes its collective issue schedule, the per-step
+    digests are identical across steps (same plan, same bucket layout —
+    what the multi-host kill/rejoin drills compare across processes),
+    and a superstep WINDOW emits the same one-digest-per-optimizer-step
+    stream as per-batch dispatch — with no telemetry session active
+    (review regression: the windowed path skipped the hasher)."""
+    from deeplearning4j_tpu.analysis.sanitizer import (
+        current_collective_hasher)
+    from deeplearning4j_tpu.analysis.ir_probes import tiny_mlp
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.parallel.trainer import (ParallelTrainer,
+                                                     ShardingStrategy)
+
+    h = current_collective_hasher()
+    assert h is not None        # installed by the sanitize marker
+    x = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[np.arange(32) % 4]
+    tr = ParallelTrainer(tiny_mlp(), strategy=ShardingStrategy.ZERO2,
+                         zero_bucket_mb=0.0005)
+    tr.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=1)
+    assert len(h.step_digests) == 2           # one digest per step
+    assert len(set(h.step_digests)) == 1      # identical schedule per step
+    per_batch = list(h.step_digests)
+    # one 2-step superstep window must produce the identical stream
+    tr2 = ParallelTrainer(tiny_mlp(), strategy=ShardingStrategy.ZERO2,
+                          zero_bucket_mb=0.0005)
+    tr2.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=1,
+            superstep=2)
+    assert h.step_digests == per_batch * 2, h.step_digests

@@ -11,6 +11,11 @@ import jax
 import deeplearning4j_tpu.parallel.distributed as dist
 from deeplearning4j_tpu.parallel import MeshAxes
 
+# ROADMAP guardrail (ISSUE 13): the multi-host glue (coordinator time
+# source, export watchers) owns background threads — run under the
+# thread-leak watchdog + lock-order shims.
+pytestmark = pytest.mark.sanitize()
+
 
 def test_initialize_single_process_noop(monkeypatch):
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)

@@ -23,6 +23,11 @@ from deeplearning4j_tpu.parallel import (MeshAxes, ParallelTrainer,
 
 from conftest import make_classification
 
+# ROADMAP guardrail (ISSUE 13): the mesh/trainer suites are concurrency-
+# heavy (prefetch threads, checkpoint writers) — run every test under the
+# graftlint runtime sanitizer's thread-leak watchdog + lock-order shims.
+pytestmark = pytest.mark.sanitize()
+
 
 def _model(seed=7, updater=None):
     conf = (NeuralNetConfiguration.builder().seed(seed)

@@ -58,7 +58,21 @@ def test_line_search_solvers_reach_lstsq_optimum(algo):
 
 
 def test_cg_converges_faster_than_plain_line_search():
-    x, y, opt_loss = _lstsq_problem(seed=3)
+    """CG beats plain line-search gradient descent at a fixed iteration
+    budget on a problem where the advantage is structural.
+
+    Determinism + calibration (ISSUE 13, same treatment as the CIFAR
+    gate in PR 11): every draw is seeded (problem from default_rng,
+    model init from .seed()), so each (seed, iters) pair is a fixed
+    function of the code. The historic seed=3 run was a RACE: by 15
+    iterations BOTH solvers sat at the least-squares optimum
+    (0.0000948 vs opt 0.000094) and the assertion compared float noise
+    (CG lost by ~7e-7 — a coin flip, failing since PR 3). On seed=0 the
+    ordering is structural, not a tie-break: CG reaches the optimum by
+    iteration 10 while LGD is still 26x above it at 15 (calibrated
+    2026-08-04: CG=5.41e-5, LGD=1.34e-3, gap -1.29e-3; gate requires a
+    1e-4 gap, >10x margin)."""
+    x, y, opt_loss = _lstsq_problem(seed=0)
     ds = DataSet(x, y)
     scores = {}
     for algo in (OptimizationAlgorithm.CONJUGATE_GRADIENT,
@@ -67,8 +81,11 @@ def test_cg_converges_faster_than_plain_line_search():
         for _ in range(15):
             m.fit(ds)
         scores[algo] = m.score()
-    assert (scores[OptimizationAlgorithm.CONJUGATE_GRADIENT]
-            <= scores[OptimizationAlgorithm.LINE_GRADIENT_DESCENT] + 1e-8)
+    cg = scores[OptimizationAlgorithm.CONJUGATE_GRADIENT]
+    lgd = scores[OptimizationAlgorithm.LINE_GRADIENT_DESCENT]
+    assert cg <= lgd - 1e-4, (cg, lgd)
+    # and CG actually converged (within 5% of the lstsq optimum)
+    assert cg <= opt_loss * 1.05 + 1e-6, (cg, opt_loss)
 
 
 def test_lbfgs_trains_classifier():

@@ -17,6 +17,11 @@ from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
 from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
                                    StatsListener, StatsStorageEvent, UIServer)
 
+# ROADMAP guardrail (ISSUE 13): the UI stack spawns HTTP server and
+# router threads — every test runs under the thread-leak watchdog +
+# lock-order shims so a server that outlives its test fails loudly.
+pytestmark = pytest.mark.sanitize()
+
 
 def _small_model(seed=5):
     conf = (NeuralNetConfiguration.builder()
@@ -474,6 +479,7 @@ def test_legacy_remote_iteration_listeners():
     srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
     _t.Thread(target=srv.serve_forever, daemon=True).start()
     url = f"http://127.0.0.1:{srv.server_address[1]}/legacy"
+    flow_l = hist_l = None
     try:
         model = _small_model()
         flow_l = RemoteFlowIterationListener(url)
@@ -495,7 +501,14 @@ def test_legacy_remote_iteration_listeners():
         hist = next(p for p in received if p["type"] == "histogram")
         assert "layer0/W" in hist["histograms"]
     finally:
+        # join the reporters' worker threads (the sanitize watchdog
+        # flagged exactly this: the listeners' WebReporters outlived
+        # the test) and stop the throwaway HTTP server
+        for lst in (flow_l, hist_l):
+            if lst is not None:
+                lst.reporter.close()
         srv.shutdown()
+        srv.server_close()
 
     # queue-on-failure: black-holed host keeps payloads pending, and
     # report() never blocks the caller

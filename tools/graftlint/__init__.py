@@ -14,8 +14,21 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _load_cli():
-    if "deeplearning4j_tpu" not in sys.modules:
+def _load_cli(ir=False):
+    if ir and "deeplearning4j_tpu" not in sys.modules:
+        # the IR tier traces REAL models on the virtual mesh: import the
+        # full package (jax included) instead of the lightweight stub,
+        # after pinning the 8-device CPU mesh env BEFORE jax initializes
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        sys.path.insert(0, _REPO)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import deeplearning4j_tpu  # noqa: F401
+    elif "deeplearning4j_tpu" not in sys.modules:
         pkg_dir = os.path.join(_REPO, "deeplearning4j_tpu")
         stub = types.ModuleType("deeplearning4j_tpu")
         stub.__path__ = [pkg_dir]
@@ -26,4 +39,5 @@ def _load_cli():
 
 
 def main(argv=None):
-    return _load_cli().main(argv)
+    ir = "--ir" in (argv if argv is not None else sys.argv[1:])
+    return _load_cli(ir=ir).main(argv)
