@@ -109,6 +109,29 @@ def bench_scaling(devices=8):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_mesh2d(devices=8):
+    """2-D mesh parallelism ablation (ISSUE 14): the transformer-block LM
+    trained TP-only (1×8) vs DP×TP (2×4) vs ZERO1×TP on both reshapes of
+    the virtual 8-device mesh, alternating paired windows. Reports
+    tokens/s per arm, measured per-device param+moment bytes (gate:
+    ZERO1×TP moments <= 0.15 of replicated, i.e. ~1/(d·m)) and the
+    per-axis collective payload of the 2-D step parsed from its compiled
+    HLO (optimizer traffic must ride the small `data` axis)."""
+    from deeplearning4j_tpu.util.platform import (
+        child_env_with_virtual_devices)
+
+    env = child_env_with_virtual_devices(devices)
+    out = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
+         "--devices", str(devices), "--mode", "mesh2d", "--steps", "2",
+         "--reps", "2"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=2700)
+    if out.returncode != 0:
+        return None
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_pipeline(devices=8):
     """GPipe bubble-fraction characterization across microbatch counts at
     S=4 on the virtual mesh (BASELINE row 6; ratios are load-robust)."""
@@ -325,6 +348,28 @@ def main():
                 "overlap_fraction": ac.get("overlap_fraction"),
                 "accumulator_bytes": ac.get("accumulator_bytes"),
                 "gate": ac.get("gate")}
+    except Exception:
+        pass
+    try:
+        # 2-D mesh parallelism (ISSUE 14): transformer-block tokens/s,
+        # TP-only vs DP×TP vs ZERO1×TP paired arms on the (2,4)/(4,2)
+        # reshapes, with measured per-device param+moment bytes and
+        # per-axis collective payloads
+        m2 = bench_mesh2d(8)
+        if m2:
+            extras["TP-2d-tokens-per-s"] = {
+                "arms": {name: {"tokens_per_s": arm["tokens_per_s"],
+                                "per_device_bytes": arm["per_device_bytes"]}
+                         for name, arm in m2["arms"].items()},
+                "zero1_tp_vs_dp_tp_paired": m2.get(
+                    "zero1_tp_vs_dp_tp_paired"),
+                "zero1_tp_vs_dp_tp_spread": m2.get(
+                    "zero1_tp_vs_dp_tp_spread"),
+                "collective_bytes_by_axis": m2.get(
+                    "collective_bytes_by_axis"),
+                "data_axis_declared_vs_measured": m2.get(
+                    "data_axis_declared_vs_measured"),
+                "gate": m2.get("gate")}
     except Exception:
         pass
     try:

@@ -22,6 +22,7 @@ from .nn.layers import (ActivationLayer, AutoEncoder, BatchNormalization,
                         CompositeReconstructionDistribution,
                         Convolution1DLayer, ConvolutionLayer, ConvolutionMode,
                         DenseLayer, DropoutLayer, EmbeddingLayer,
+                        EmbeddingSequenceLayer, TransformerBlock,
                         GaussianReconstructionDistribution,
                         GlobalPoolingLayer, GravesBidirectionalLSTM,
                         GravesLSTM, LocalResponseNormalization,
@@ -57,7 +58,8 @@ __all__ = [
     "BernoulliReconstructionDistribution", "CenterLossOutputLayer",
     "CompositeReconstructionDistribution", "Convolution1DLayer",
     "ConvolutionLayer", "ConvolutionMode", "DenseLayer", "DropoutLayer",
-    "EmbeddingLayer", "GaussianReconstructionDistribution",
+    "EmbeddingLayer", "EmbeddingSequenceLayer", "TransformerBlock",
+    "GaussianReconstructionDistribution",
     "GlobalPoolingLayer", "GravesBidirectionalLSTM", "GravesLSTM",
     "LocalResponseNormalization", "LossFunctionWrapper", "LossLayer",
     "OutputLayer", "PoolingType", "RBM", "RnnOutputLayer",

@@ -75,7 +75,8 @@ from .engine import (Finding, LintResult, baseline_diff, load_baseline,
 
 __all__ = ["IrEntry", "analyze_entry", "run_ir_lint", "collective_sequence",
            "sequence_digest", "check_cross_program_order",
-           "measured_collective_bytes", "IR_RULES", "IR_BASELINE_SECTION"]
+           "measured_collective_bytes", "measured_collective_bytes_by_axis",
+           "IR_RULES", "IR_BASELINE_SECTION"]
 
 IR_BASELINE_SECTION = "ir_findings"
 
@@ -127,6 +128,12 @@ class IrEntry:
     mesh_axes: Tuple[str, ...] = ()
     declared_bytes: Optional[int] = None   # static per-program collective payload
     check_bytes: bool = False              # byte-diff only for scan-free steps
+    # 2-D mesh entries (ISSUE 14): per-axis byte budgets, diffed against
+    # the measured collectives CLASSIFIED BY AXIS via replica-group size
+    # (axis_sizes = {"data": d, "model": m}; sizes must be distinct or
+    # the classification falls back to "other" and the check skips)
+    declared_bytes_by_axis: Optional[Dict[str, int]] = None
+    axis_sizes: Optional[Dict[str, int]] = None
     expected_constraints: Optional[int] = None
     requires_ordered_reductions: bool = False
     asserts_bitexact: bool = False
@@ -241,6 +248,59 @@ def measured_collective_bytes(hlo_text: str) -> Dict[str, int]:
             continue    # async pair: payload counted once at -start
         b = _shape_bytes(operands if op == "reduce-scatter" else shape)
         out[op] = out.get(op, 0) + b
+    return out
+
+
+_FIRST_GROUP = re.compile(r"\{(\d+(?:\s*,\s*\d+)*)\}")
+_IOTA_GROUPS = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def _replica_group_size(line: str) -> Optional[int]:
+    """Participant count per replica group of a collective instruction
+    line — the key that maps it onto a mesh axis. Handles both HLO
+    forms: explicit `replica_groups={{0,4},{1,5},...}` (count the first
+    group's members) and iota `replica_groups=[G,S]<=[...]` (S). None
+    when the line carries no groups (the collective spans everything)."""
+    m = _IOTA_GROUPS.search(line)
+    if m:
+        return int(m.group(2))
+    if "replica_groups=" not in line:
+        return None
+    m = _FIRST_GROUP.search(line.split("replica_groups=", 1)[1])
+    if m:
+        return len(m.group(1).split(","))
+    return None
+
+
+def measured_collective_bytes_by_axis(hlo_text: str,
+                                      axis_sizes: Dict[str, int]
+                                      ) -> Dict[str, Dict[str, int]]:
+    """`measured_collective_bytes` split by MESH AXIS: each collective is
+    attributed to the axis whose size equals its replica-group size
+    (on a (2, 4) mesh, groups of 2 ride "data", groups of 4 ride
+    "model"). Collectives whose group size matches no axis — or matches
+    more than one (d == m; use distinct sizes for checkable meshes) —
+    land under "other". This is how the IR tier verifies the 2-D
+    contract: ZeRO's optimizer collectives must ride the data axis at
+    the plan's declared payload, and the model axis must carry only the
+    Megatron activation psums."""
+    inverse: Dict[int, List[str]] = {}
+    for ax, n in axis_sizes.items():
+        inverse.setdefault(int(n), []).append(ax)
+    out: Dict[str, Dict[str, int]] = {}
+    for ln in hlo_text.splitlines():
+        m = _INSTR.search(ln)
+        if not m:
+            continue
+        _, shape, op, suffix, operands, _ = m.groups()
+        if suffix == "-done":
+            continue
+        b = _shape_bytes(operands if op == "reduce-scatter" else shape)
+        gsize = _replica_group_size(ln)
+        axes = inverse.get(gsize, []) if gsize is not None else []
+        ax = axes[0] if len(axes) == 1 else "other"
+        bucket = out.setdefault(ax, {})
+        bucket[op] = bucket.get(op, 0) + b
     return out
 
 
@@ -458,6 +518,21 @@ def analyze_entry(entry: IrEntry) -> List[Finding]:
                 f"by the step's static accounting (slack-adjusted budget "
                 f"{budget}) — a sharded tensor is being materialized "
                 "replicated", "bytes"))
+    if entry.declared_bytes_by_axis and entry.axis_sizes:
+        by_axis = measured_collective_bytes_by_axis(text, entry.axis_sizes)
+        for ax in sorted(entry.declared_bytes_by_axis):
+            declared = entry.declared_bytes_by_axis[ax]
+            got = sum(by_axis.get(ax, {}).values())
+            budget = int(declared * entry.byte_slack) + 1024
+            if got > budget:
+                findings.append(entry.finding(
+                    "ir-implicit-reshard",
+                    f"GSPMD inserted {got} collective bytes on the "
+                    f"'{ax}' mesh axis ({by_axis.get(ax, {})}) against "
+                    f"{declared} declared for that axis (slack-adjusted "
+                    f"budget {budget}) — a tensor sharded over the other "
+                    "axis is being materialized/resharded here",
+                    f"bytes:{ax}"))
     if entry.expected_constraints is not None and jaxpr is not None:
         got = count_primitives(jaxpr, "sharding_constraint")
         if got < entry.expected_constraints:

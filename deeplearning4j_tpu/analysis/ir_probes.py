@@ -28,8 +28,8 @@ from typing import List, Optional, Tuple
 from .ir import IrEntry
 
 __all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
-           "parallel_entries", "zero_accum_entry", "serving_entries",
-           "virtual_mesh"]
+           "parallel_entries", "zero_accum_entry", "mesh2d_entries",
+           "mesh2d_zero1_tp_entry", "serving_entries", "virtual_mesh"]
 
 
 def virtual_mesh():
@@ -249,6 +249,160 @@ def zero_accum_entry(stage: int = 2, bucket_mb: float = 0.0005,
         asserts_bitexact=True)
 
 
+def _mesh2d_tp_entry(shape: Tuple[int, int]
+                     ) -> Tuple[IrEntry, int, int]:
+    """The DP×TP train step on a (data, model) mesh, plus its measured
+    MODEL-axis collective bytes (the Megatron activation-psum traffic)
+    and its "other"-bucket bytes (collectives spanning neither single
+    axis: whole-mesh groups, permutes). Both measurements become byte
+    BUDGETS for the matching ZERO1×TP entry: ZeRO-1 only adds data-axis
+    optimizer collectives, so extra model-axis traffic means a model
+    shard is being silently resharded — and the "other" budget closes
+    the remaining hole, a rematerialization compiled as ONE gather over
+    BOTH axes (replica group size d·m) that axis-bucketed budgets alone
+    would never see."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..analysis.ir import measured_collective_bytes_by_axis
+    from ..parallel.trainer import ParallelTrainer, ShardingStrategy
+
+    d, m = shape
+    tr = ParallelTrainer(tiny_mlp(), mesh_shape=shape,
+                         strategy=ShardingStrategy.TENSOR_PARALLEL)
+    x, y = _batch()
+    args = (tr._params, tr._state, tr._opt, jnp.asarray(0, jnp.int32),
+            x, y, jax.random.PRNGKey(0), None, None)
+    fn = tr._step_fn.__wrapped__
+    text = fn.trace(*args).lower().compile().as_text()
+    by_axis = measured_collective_bytes_by_axis(
+        text, {"data": d, "model": m})
+    model_bytes = sum(by_axis.get("model", {}).values())
+    other_bytes = sum(by_axis.get("other", {}).values())
+    entry = IrEntry(
+        f"parallel/tp_step_{d}x{m}", "parallel/trainer.py",
+        fn=fn, args=args, mesh_axes=tuple(tr.mesh.axis_names))
+    return entry, model_bytes, other_bytes
+
+
+def mesh2d_zero1_tp_entry(shape: Tuple[int, int] = (2, 4),
+                          model_budget: Optional[int] = None,
+                          other_budget: int = 0,
+                          mutate: Optional[str] = None) -> IrEntry:
+    """The ZERO1×TP train step on a (data, model) mesh, carrying the
+    extended 2-D contract: per-AXIS byte budgets (data = the plan's
+    declared optimizer payload, model = the paired TP step's measured
+    activation traffic) and the plan's `with_sharding_constraint`
+    schedule. Public so tests can seed mutations through the same
+    builder:
+
+      mutate="drop_constraints"  the step skips constrain_params/opt
+                                 entirely — the traced constraint count
+                                 falls below the declared schedule
+      mutate="drop_model_axis"   constraints keep their COUNT but lose
+                                 the model axis (data-only specs): the
+                                 update materializes params replicated
+                                 over `model` and the model-axis bytes
+                                 blow the TP-derived budget
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MeshAxes, make_mesh
+    from ..parallel.sharding import (ShardingStrategy, model_layer_hints,
+                                     param_specs)
+    from ..parallel.zero import (ZeroConfig, _ZeroPlan, make_zero_step,
+                                 zero_opt_shardings)
+    from ..telemetry.compile_watch import watch_compiles
+
+    d, m = shape
+    model = tiny_mlp()
+    mesh = make_mesh({MeshAxes.DATA: d, MeshAxes.MODEL: m})
+    base = param_specs(model.params, ShardingStrategy.ZERO1_TP, mesh,
+                       layers=model_layer_hints(model))
+    cfg = ZeroConfig(stage=1)
+    if mutate is None:
+        step, info = make_zero_step(model, mesh, config=cfg,
+                                    base_specs=base,
+                                    model_axis=MeshAxes.MODEL)
+    else:
+        # seeded mutations re-assemble the step body so the contract
+        # (expected constraints / per-axis budgets) stays the TRUE plan's
+        true_plan = _ZeroPlan(model, mesh, MeshAxes.DATA, cfg,
+                              base_specs=base, model_axis=MeshAxes.MODEL)
+        info = dict(true_plan.info)
+        info["expected_constraints"] = true_plan.expected_constraints()
+        if mutate == "drop_model_axis":
+            plan = _ZeroPlan(model, mesh, MeshAxes.DATA, cfg)  # data-only
+        elif mutate == "drop_constraints":
+            plan = None
+        else:
+            raise ValueError(f"unknown mutation {mutate!r}")
+        grad_fn = model.grad_step_fn
+
+        def step(params, state, opt_state, step_i, x, y, rng, fm, lm):
+            score, new_state, grads = grad_fn(params, state, x, y, rng,
+                                              fm, lm)
+            new_params, new_opt = model.apply_updates(params, grads,
+                                                      opt_state, step_i)
+            if plan is not None:
+                new_params = plan.constrain_params(new_params)
+                new_opt = plan.constrain_opt(new_opt)
+            return new_params, new_state, new_opt, score
+
+    repl = NamedSharding(mesh, P())
+    batch = NamedSharding(mesh, P(MeshAxes.DATA))
+    p_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), base,
+        is_leaf=lambda s: isinstance(s, P))
+    o_sh = zero_opt_shardings(model.updater_state, model.params, mesh,
+                              base=base)
+    jitted = watch_compiles(jax.jit(
+        step,
+        in_shardings=(p_sh, repl, o_sh, repl, batch, batch, repl, batch,
+                      batch),
+        out_shardings=(p_sh, repl, o_sh, repl),
+        donate_argnums=(0, 1, 2)),
+        f"analysis/ir_probe:zero1_tp_step_{d}x{m}").__wrapped__
+    x, y = _batch()
+    params = jax.device_put(model.params, p_sh)
+    opt = jax.device_put(model.updater_state, o_sh)
+    entry = IrEntry(
+        f"parallel/zero1_tp_step_{d}x{m}", "parallel/zero.py",
+        fn=jitted,
+        args=(params, model.state, opt, jnp.asarray(0, jnp.int32),
+              x, y, jax.random.PRNGKey(0), None, None),
+        mesh_axes=tuple(mesh.axis_names),
+        expected_constraints=info.get("expected_constraints"))
+    if model_budget is not None:
+        entry.axis_sizes = {"data": d, "model": m}
+        # "other" is budgeted too (TP-measured + slack floor): a sharded
+        # tensor rematerialized via ONE whole-mesh gather (group size
+        # d·m) lands in that bucket, not under either axis
+        entry.declared_bytes_by_axis = {
+            "data": sum(info["bytes"].values()),
+            "model": model_budget,
+            "other": int(other_budget)}
+    return entry
+
+
+def mesh2d_entries() -> List[IrEntry]:
+    """The 2-D train-step family (ISSUE 14) on BOTH reshapes of the
+    8-device mesh — (2, 4) and (4, 2), distinct axis sizes so the
+    per-axis byte classification is unambiguous. Each reshape registers
+    the DP×TP step and the ZERO1×TP step; the TP step's measured
+    model-axis traffic becomes the ZeRO entry's model-axis budget."""
+    entries: List[IrEntry] = []
+    for shape in ((2, 4), (4, 2)):
+        tp_entry, model_bytes, other_bytes = _mesh2d_tp_entry(shape)
+        entries.append(tp_entry)
+        entries.append(mesh2d_zero1_tp_entry(shape,
+                                             model_budget=model_bytes,
+                                             other_budget=other_bytes))
+    return entries
+
+
 def serving_entries() -> List[IrEntry]:
     """The serving plane's AOT executables: register a tiny model, then
     audit exactly the compiled runners request threads will invoke."""
@@ -271,5 +425,6 @@ def build_entries() -> List[IrEntry]:
     entries += graph_entries()
     entries += parallel_entries()
     entries.append(zero_accum_entry())
+    entries += mesh2d_entries()
     entries += serving_entries()
     return entries

@@ -18,6 +18,7 @@ from .generative import (AutoEncoder, RBM, VariationalAutoencoder,
                          CompositeReconstructionDistribution,
                          LossFunctionWrapper)
 from .moe import MixtureOfExpertsLayer
+from .transformer import EmbeddingSequenceLayer, TransformerBlock
 
 __all__ = [
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer",
@@ -32,4 +33,5 @@ __all__ = [
     "GaussianReconstructionDistribution", "BernoulliReconstructionDistribution",
     "CompositeReconstructionDistribution", "LossFunctionWrapper",
     "MixtureOfExpertsLayer",
+    "EmbeddingSequenceLayer", "TransformerBlock",
 ]

@@ -224,6 +224,15 @@ def build_accum_superstep(grad_fn, update_fn, skip_nonfinite: bool = False):
         (NaN when every microbatch was bad, so the guard still catches a
         fully-poisoned step). The raw per-microbatch scores are returned
         alongside so the host can count the skips.
+      * The per-microbatch score stack is accumulated in a CARRIED [M]
+        buffer with an explicit int32 index rather than as a scan output:
+        on a 2-D (data, model) mesh (ISSUE 14) GSPMD shards the
+        scan-output stacking buffer over a mesh axis whose size divides
+        M, and this XLA version then mis-types the partitioned scan
+        update (s64 loop index vs s32 partition offset — a verifier
+        error after SPMD partitioning). The hand-indexed buffer keeps
+        the update's index arithmetic int32 and the buffer off the mesh;
+        the values are identical, so grouping invariance is unaffected.
 
     Returns ``(params, state, opt, rng, scores[K], micro_scores[K, M])``.
     """
@@ -235,9 +244,10 @@ def build_accum_superstep(grad_fn, update_fn, skip_nonfinite: bool = False):
 
         def opt_body(carry, inp):
             params, state, opt, step, rng = carry
+            n_micro = jax.tree_util.tree_leaves(inp)[0].shape[0]
 
             def micro_body(mcarry, minp):
-                state, rng, acc, n_ok, ssum = mcarry
+                state, rng, acc, n_ok, ssum, mbuf, mi = mcarry
                 x, y, f, l = minp
                 rng, k = jax.random.split(rng)
                 score, new_state, grads = grad_fn(params, state, x, y, k,
@@ -260,12 +270,19 @@ def build_accum_superstep(grad_fn, update_fn, skip_nonfinite: bool = False):
                     state = new_state
                     n_ok = n_ok + 1.0
                     ssum = ssum + score
-                return (state, rng, acc, n_ok, ssum), score
+                # carried, int32-indexed score buffer (NOT a scan output)
+                # — see the docstring's 2-D-mesh partitioner note
+                mbuf = jax.lax.dynamic_update_index_in_dim(
+                    mbuf, score.astype(f32), mi, 0)
+                return (state, rng, acc, n_ok, ssum, mbuf,
+                        mi + jnp.int32(1)), None
 
             acc0 = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(jnp.shape(p), f32), params)
-            (state, rng, acc, n_ok, ssum), mscores = jax.lax.scan(
-                micro_body, (state, rng, acc0, f32(0.0), f32(0.0)), inp)
+            (state, rng, acc, n_ok, ssum, mscores, _mi), _ = jax.lax.scan(
+                micro_body, (state, rng, acc0, f32(0.0), f32(0.0),
+                             jnp.zeros((n_micro,), f32), jnp.int32(0)),
+                inp)
             denom = jnp.maximum(n_ok, 1.0)
             gmean = jax.tree_util.tree_map(
                 lambda a, p: (a / denom).astype(jnp.result_type(p)),

@@ -1,4 +1,4 @@
-"""Parameter sharding rules (tensor parallelism / FSDP).
+"""Parameter sharding rules (tensor parallelism / FSDP / 2-D meshes).
 
 The reference has NO tensor parallelism (`SURVEY.md` §2.4: model/tensor/
 pipeline parallelism absent) — this is new TPU-native capability. Rules
@@ -11,9 +11,19 @@ Strategies:
     ParallelWrapper / ParameterAveragingTrainingMaster sync mode)
   * tensor_parallel — Megatron-style: 2-D weights sharded on the output
     feature axis over "model"; biases sharded to match; embedding/LSTM/conv
-    sharded on their output-channel axis
+    sharded on their output-channel axis. Layers that know their Megatron
+    role override `LayerConf.tp_shard_axis` (nn/layers/transformer.py:
+    column-parallel QKV/FFN-in on the output axis, ROW-parallel
+    attention-out/FFN-out on the contraction axis, vocab-sharded
+    embeddings, replicated LayerNorms) — the generic last-axis rule is
+    the fallback for layers without a declared role.
   * fsdp — every tensor sharded on its largest axis over "data"
     (ZeRO-3-style param sharding; XLA re-gathers on use)
+  * zero1_tp — 2-D (data, model) composition (ISSUE 14): params sharded
+    over "model" exactly as tensor_parallel; optimizer moments
+    additionally sharded over "data" by parallel/zero.py, so no device
+    holds more than ~1/(d·m) of the moment bytes. Params are NOT
+    replicated between steps (each device holds its model shard).
 """
 from __future__ import annotations
 
@@ -38,19 +48,60 @@ class ShardingStrategy:
     # inside the step) are sharded over the data axis
     ZERO1 = "zero1"
     ZERO2 = "zero2"
+    # 2-D composition: Megatron tensor parallelism over "model" × ZeRO-1
+    # sharded optimizer over "data" (params model-sharded between steps,
+    # moments (data, model)-sharded, param allgather rides ONLY the data
+    # axis)
+    ZERO1_TP = "zero1_tp"
 
     #: strategies under which every device holds the full params between
-    #: steps (evaluation/scoring may pull a host-local copy safely)
+    #: steps (evaluation/scoring may pull a host-local copy safely).
+    #: ZERO1_TP is NOT here: its params live model-sharded.
     PARAMS_REPLICATED = (REPLICATED, ZERO1, ZERO2)
 
 
-def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh):
-    """Output-feature-axis sharding for a single param tensor. Expert-
-    indexed tensors (`expert_*`, leading axis = n_experts — see
-    nn/layers/moe.py) shard on axis 0 instead: expert parallelism."""
+def _layer_hint(layers, path):
+    """The LayerConf owning a param leaf, resolved from the tree path:
+    tuple-of-dicts params (MultiLayerNetwork) index `layers` as a
+    sequence; dict-of-dicts (ComputationGraph) look the vertex name up in
+    a mapping. None when no hint source is available."""
+    if layers is None or not path:
+        return None
+    head = path[0]
+    if hasattr(head, "idx"):
+        try:
+            return layers[head.idx]
+        except (IndexError, TypeError):
+            return None
+    key = getattr(head, "key", None)
+    if isinstance(layers, dict):
+        return layers.get(key)
+    return None
+
+
+def _tp_spec_for(key: str, shape, axis: str, mesh: Mesh, layer=None):
+    """Model-axis sharding for a single param tensor. A layer that
+    declares its Megatron role via `tp_shard_axis` pins the sharded axis
+    (column-parallel output axis, row-parallel contraction axis, vocab
+    axis, or "replicated"); otherwise: expert-indexed tensors
+    (`expert_*`, leading axis = n_experts — see nn/layers/moe.py) shard
+    on axis 0 (expert parallelism) and everything else shards its last
+    axis (output features / channels / gate blocks) when divisible."""
     size = mesh.shape[axis]
     nd = len(shape)
     if nd == 0:
+        return P()
+    role = None
+    if layer is not None and hasattr(layer, "tp_shard_axis"):
+        role = layer.tp_shard_axis(key, shape)
+    if role == "replicated":
+        return P()
+    if role is not None:
+        ax = role % nd
+        if shape[ax] % size == 0 and shape[ax] >= size:
+            spec = [None] * nd
+            spec[ax] = axis
+            return P(*spec)
         return P()
     if key.startswith("expert_") and shape[0] % size == 0 \
             and shape[0] >= size:
@@ -74,19 +125,50 @@ def _fsdp_spec_for(shape, axis: str, mesh: Mesh):
     return P()
 
 
+def model_layer_hints(model):
+    """The per-leaf layer-hint source `param_specs(layers=...)` consumes:
+    the layer sequence for a MultiLayerNetwork, the vertex-name -> conf
+    mapping for a ComputationGraph, None for anything else."""
+    from ..nn.graph import ComputationGraph
+
+    if isinstance(model, ComputationGraph):
+        return dict(model.conf.vertices)
+    return getattr(model, "layers", None)
+
+
 def param_specs(params, strategy: str, mesh: Mesh,
                 model_axis: str = MeshAxes.MODEL,
-                data_axis: str = MeshAxes.DATA):
+                data_axis: str = MeshAxes.DATA, layers=None):
     """PartitionSpec pytree matching `params` (a MultiLayerNetwork tuple-of-
-    dicts or ComputationGraph dict-of-dicts)."""
+    dicts or ComputationGraph dict-of-dicts). `layers` (optional — the
+    model's layer sequence or vertex mapping, see `model_layer_hints`)
+    lets layers that declare a Megatron TP role (`tp_shard_axis`) pin
+    their sharded axis; without it the generic last-axis rule applies."""
     if strategy in ShardingStrategy.PARAMS_REPLICATED:
         # ZeRO strategies shard OPTIMIZER state (zero.zero_opt_shardings),
         # not the params themselves
         return jax.tree_util.tree_map(lambda a: P(), params)
-    if strategy == ShardingStrategy.TENSOR_PARALLEL:
+    if strategy in (ShardingStrategy.TENSOR_PARALLEL,
+                    ShardingStrategy.ZERO1_TP):
+        # layers may veto a layout they cannot run locally (e.g. a
+        # transformer whose head count the model axis does not divide
+        # would silently reshard inside attention) — ask each hinted
+        # layer up front, one actionable error instead of stray
+        # collectives
+        if layers is not None:
+            size = int(mesh.shape[model_axis])
+            items = layers.values() if isinstance(layers, dict) else layers
+            for hint in items:
+                validate = getattr(hint, "tp_validate", None)
+                if validate is not None:
+                    validate(size)
+
+        # ZERO1_TP params carry the identical Megatron layout; only the
+        # OPTIMIZER state grows the extra data axis (zero.py)
         def spec(path, leaf):
             key = str(path[-1].key) if hasattr(path[-1], "key") else ""
-            return _tp_spec_for(key, np.shape(leaf), model_axis, mesh)
+            return _tp_spec_for(key, np.shape(leaf), model_axis, mesh,
+                                layer=_layer_hint(layers, path))
         return jax.tree_util.tree_map_with_path(spec, params)
     if strategy == ShardingStrategy.FSDP:
         return jax.tree_util.tree_map(
@@ -99,7 +181,8 @@ def shard_model(model, mesh: Mesh, strategy: str = ShardingStrategy.REPLICATED,
                 data_axis: str = MeshAxes.DATA):
     """Place a model's params/state/updater state on the mesh according to the
     strategy. Returns the sharding pytrees used (params_sh, state_sh, opt_sh)."""
-    specs = param_specs(model.params, strategy, mesh, model_axis, data_axis)
+    specs = param_specs(model.params, strategy, mesh, model_axis, data_axis,
+                        layers=model_layer_hints(model))
     params_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
@@ -116,19 +199,42 @@ def shard_model(model, mesh: Mesh, strategy: str = ShardingStrategy.REPLICATED,
     return params_sh, repl, opt_sh
 
 
+def _path_head(path):
+    head = path[0] if path else None
+    if hasattr(head, "idx"):
+        return head.idx
+    return getattr(head, "key", None)
+
+
 def _opt_sharding_like(opt_state, params, params_sh):
-    """Optimizer-state sharding congruent to params: each moment tensor gets
-    its param's sharding (matched by shape); scalars replicated."""
-    flat_params = jax.tree_util.tree_leaves(params)
+    """Optimizer-state sharding congruent to params: each moment tensor
+    gets its param's sharding. Matched by (layer/vertex, param key,
+    shape) — moment trees nest the param dict under per-state names
+    ({"m": {...}, "v": {...}}), so the moment's LAST path key and its
+    layer head identify the param exactly. Shape-only matching is the
+    fallback (untyped states), but it cannot be primary: under the 2-D
+    layer-role specs two same-shaped params can carry DIFFERENT specs
+    (a [F, F] attention projection vs a [T, F] table), and a
+    first-shape-wins match would silently hand a moment the wrong
+    layout. Scalars and unmatched leaves replicate."""
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
     flat_sh = jax.tree_util.tree_leaves(
         params_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    by_key = {}
     by_shape = {}
-    for p, s in zip(flat_params, flat_sh):
+    for (path, p), s in zip(flat_p, flat_sh):
+        key = getattr(path[-1], "key", None) if path else None
+        by_key.setdefault((_path_head(path), key, tuple(np.shape(p))), s)
         by_shape.setdefault(tuple(np.shape(p)), s)
     some = flat_sh[0] if flat_sh else None
     repl = NamedSharding(some.mesh, P()) if some is not None else None
 
-    def pick(leaf):
-        return by_shape.get(tuple(np.shape(leaf)), repl)
+    def pick(path, leaf):
+        shape = tuple(np.shape(leaf))
+        key = getattr(path[-1], "key", None) if path else None
+        s = by_key.get((_path_head(path), key, shape))
+        if s is not None:
+            return s
+        return by_shape.get(shape, repl)
 
-    return jax.tree_util.tree_map(pick, opt_state)
+    return jax.tree_util.tree_map_with_path(pick, opt_state)

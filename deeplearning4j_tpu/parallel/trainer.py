@@ -66,14 +66,43 @@ _MODE_STRATEGIES = {
     TrainingMode.SYNC: (
         ShardingStrategy.REPLICATED, ShardingStrategy.TENSOR_PARALLEL,
         ShardingStrategy.FSDP, ShardingStrategy.ZERO1,
-        ShardingStrategy.ZERO2, ShardingStrategy.PIPELINE),
+        ShardingStrategy.ZERO2, ShardingStrategy.ZERO1_TP,
+        ShardingStrategy.PIPELINE),
     TrainingMode.AVERAGING: (ShardingStrategy.REPLICATED,),
 }
 
+#: strategies that compose with a 2-D (data, model) mesh (model axis
+#: size > 1): replicated ignores the model axis (baseline arm of the
+#: mesh2d ablations), tensor_parallel is DP×TP, zero1_tp is ZeRO-1×TP
+_MESH2D_STRATEGIES = (ShardingStrategy.REPLICATED,
+                      ShardingStrategy.TENSOR_PARALLEL,
+                      ShardingStrategy.ZERO1_TP)
 
-def _validate_mode_strategy(mode: str, strategy: str) -> None:
-    """One actionable error for every unsupported (mode, strategy) pair —
-    raised before any mesh/model work instead of deep inside _prepare."""
+#: why each remaining strategy is NOT a 2-D citizen (the actionable half
+#: of the rejection message)
+_MESH2D_HINTS = {
+    ShardingStrategy.ZERO1: (
+        "zero1 shards moments over 'data' only and would leave the model "
+        "axis training redundant replicas — use strategy='zero1_tp' to "
+        "shard params over 'model' AND moments over 'data'"),
+    ShardingStrategy.ZERO2: (
+        "zero2's bucketed reduce-scatter packs full-size gradient leaves "
+        "and is not generalized to model-sharded gradients yet — use "
+        "strategy='zero1_tp' (ZeRO-1 × tensor parallel)"),
+    ShardingStrategy.FSDP: (
+        "fsdp shards params over 'data'; composing it with a model axis "
+        "is not supported — use strategy='zero1_tp'"),
+    ShardingStrategy.PIPELINE: (
+        "the pipeline trainer stages over its own 'pipe' axis — build "
+        "the mesh with {'pipe': n} instead of a model axis"),
+}
+
+
+def _validate_mode_strategy(mode: str, strategy: str, mesh=None,
+                            model_axis: str = MeshAxes.MODEL) -> None:
+    """One actionable error for every unsupported (mode, strategy,
+    mesh-shape) combination — raised before any mesh/model work instead
+    of failing deep in _prepare (or as a KeyError inside param_specs)."""
     pairs = "; ".join(
         f"{m}: {', '.join(s)}" for m, s in sorted(_MODE_STRATEGIES.items()))
     if mode not in _MODE_STRATEGIES:
@@ -90,10 +119,36 @@ def _validate_mode_strategy(mode: str, strategy: str) -> None:
             hint = (" — parameter averaging needs every device to hold an "
                     "independent FULL replica; use TrainingMode.SYNC for "
                     "sharded strategies (tensor_parallel/fsdp/zero1/zero2/"
-                    "pipeline)")
+                    "zero1_tp/pipeline)")
         raise ValueError(
             f"mode={mode} does not support strategy='{strategy}'{hint}. "
             f"Supported mode -> strategies: {pairs}")
+    if mesh is None:
+        return
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    model_size = int(axes.get(model_axis, 1))
+    if strategy in (ShardingStrategy.TENSOR_PARALLEL,
+                    ShardingStrategy.ZERO1_TP) \
+            and model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"strategy='{strategy}' shards params over a '{model_axis}' "
+            f"mesh axis, but the mesh only carries {mesh.axis_names}. "
+            "Build a 2-D mesh: ParallelTrainer(model, mesh_shape=(d, m)) "
+            "or mesh=make_mesh({'data': d, 'model': m})")
+    if model_size > 1:
+        if mode == TrainingMode.AVERAGING:
+            raise ValueError(
+                f"mode={mode} does not support a 2-D mesh (model axis "
+                f"size {model_size}) — parameter averaging keeps one "
+                "independent replica per DATA device; use "
+                "TrainingMode.SYNC with strategy='tensor_parallel' or "
+                "'zero1_tp' on 2-D meshes")
+        if strategy not in _MESH2D_STRATEGIES:
+            raise ValueError(
+                f"strategy='{strategy}' does not support a 2-D mesh "
+                f"(model axis size {model_size}): "
+                f"{_MESH2D_HINTS[strategy]}. Supported 2-D strategies: "
+                f"{', '.join(_MESH2D_STRATEGIES)}")
 
 
 class ParallelTrainer:
@@ -125,21 +180,49 @@ class ParallelTrainer:
                  model_axis: str = MeshAxes.MODEL,
                  collect_stats: bool = False,
                  zero_bucket_mb: Optional[float] = None,
-                 zero_reduce_dtype: Optional[str] = None):
-        _validate_mode_strategy(mode, strategy)
+                 zero_reduce_dtype: Optional[str] = None,
+                 mesh_shape: Optional[tuple] = None):
+        if mesh_shape is not None:
+            # 2-D shorthand (ISSUE 14): mesh_shape=(d, m) builds the
+            # (data, model) mesh — d-way ZeRO/data parallelism × m-way
+            # Megatron tensor parallelism on d·m devices
+            if mesh is not None:
+                raise ValueError("pass mesh= OR mesh_shape=(d, m), not both")
+            if len(mesh_shape) != 2:
+                raise ValueError(
+                    f"mesh_shape must be (data, model), got {mesh_shape!r}")
+            mesh = make_mesh({data_axis: int(mesh_shape[0]),
+                              model_axis: int(mesh_shape[1])})
+        mesh = mesh if mesh is not None else make_mesh()
+        _validate_mode_strategy(mode, strategy, mesh, model_axis)
         if (strategy not in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2)
                 and (zero_bucket_mb is not None
                      or zero_reduce_dtype is not None)):
             # silently ignoring the knobs would let a user believe they
             # enabled bucketing / the bf16 wire on a step that has neither
+            # (ZERO1_TP is stage 1: no buckets, no narrow wire)
             raise ValueError(
                 "zero_bucket_mb/zero_reduce_dtype only apply to the ZeRO "
                 f"strategies (zero1/zero2); strategy='{strategy}' ignores "
                 "them — drop the knobs or switch strategy")
         if model.params is None:
             model.init()
+        # layers with a kernel-vs-einsum attention switch (TransformerBlock
+        # `flash`) must take the einsum path under ANY trainer-managed
+        # sharding: GSPMD cannot partition a Pallas custom call, so the
+        # flash kernel inside a sharded jit would force replication (or
+        # fail to partition) — exactly the silent reshard the IR lint
+        # exists to catch. Instance attr only; standalone/single-device
+        # use keeps the class-level "auto".
+        from ..nn.graph import ComputationGraph
+        layer_confs = (model.conf.vertices.values()
+                       if isinstance(model, ComputationGraph)
+                       else getattr(model, "layers", ()) or ())
+        for conf_l in layer_confs:
+            if hasattr(conf_l, "flash"):
+                conf_l.flash = False
         self.model = model
-        self.mesh = mesh if mesh is not None else make_mesh()
+        self.mesh = mesh
         self.mode = mode
         self.strategy = strategy
         self.averaging_frequency = max(1, int(averaging_frequency))
@@ -205,25 +288,44 @@ class ParallelTrainer:
         self._batch_sh = batch_sh
         self._p_sh = repl
         if self.mode == TrainingMode.SYNC and self.strategy in (
-                ShardingStrategy.ZERO1, ShardingStrategy.ZERO2):
+                ShardingStrategy.ZERO1, ShardingStrategy.ZERO2,
+                ShardingStrategy.ZERO1_TP):
             # ZeRO: params replicated between steps, optimizer moments
             # sharded over the data axis; the step reduce-scatters grads
             # (stage 2), updates only the local shard and allgathers the
             # new params via the replicated out-sharding. Buffers donate
             # end-to-end exactly like the replicated step.
+            #
+            # ZERO1_TP (ISSUE 14): params live MODEL-sharded between
+            # steps (Megatron specs from sharding.py), moments shard over
+            # (model, data), and the TP param out-sharding pins the
+            # trailing allgather to the DATA axis only — no device holds
+            # more than 1/m of the params or ~1/(d·m) of the moments.
+            from .sharding import model_layer_hints
             from .zero import (DEFAULT_BUCKET_MB, ZeroConfig, make_zero_step,
                                zero_opt_shardings)
+            two_d = self.strategy == ShardingStrategy.ZERO1_TP
             cfg = ZeroConfig(
-                stage=1 if self.strategy == ShardingStrategy.ZERO1 else 2,
+                stage=2 if self.strategy == ShardingStrategy.ZERO2 else 1,
                 bucket_mb=(DEFAULT_BUCKET_MB if self.zero_bucket_mb is None
                            else self.zero_bucket_mb),
                 reduce_dtype=self.zero_reduce_dtype)
+            base_specs = None
+            p_sh = repl
+            if two_d:
+                base_specs = param_specs(
+                    m.params, self.strategy, mesh, self.model_axis,
+                    self.data_axis, layers=model_layer_hints(m))
+                p_sh = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), base_specs,
+                    is_leaf=lambda x: isinstance(x, P))
             step_fn, self._zero_info = make_zero_step(
-                m, mesh, data_axis=self.data_axis, config=cfg)
+                m, mesh, data_axis=self.data_axis, config=cfg,
+                base_specs=base_specs,
+                model_axis=self.model_axis if two_d else None)
             o_sh = zero_opt_shardings(m.updater_state, m.params, mesh,
-                                      self.data_axis)
-            self._p_sh = repl
-            self._params = jax.device_put(m.params, repl)
+                                      self.data_axis, base=base_specs)
+            self._p_sh = p_sh
             self._state = jax.device_put(m.state, repl)
             if jax.process_count() > 1:
                 # device_put of a host tree onto a NON-fully-addressable
@@ -234,19 +336,30 @@ class ParallelTrainer:
                 self._opt = watch_compiles(
                     jax.jit(lambda t: t, out_shardings=o_sh),
                     "parallel/opt_placement")(opt)
+                if two_d:
+                    par = jax.device_put(m.params, repl)
+                    self._params = watch_compiles(
+                        jax.jit(lambda t: t, out_shardings=p_sh),
+                        "parallel/param_placement")(par)
+                else:
+                    self._params = jax.device_put(m.params, repl)
             else:
                 self._opt = jax.device_put(m.updater_state, o_sh)
+                self._params = jax.device_put(m.params, p_sh)
             self._raw_step_fn = step_fn
             self._o_sh = o_sh
             self._step_fn = watch_compiles(jax.jit(
                 step_fn,
-                in_shardings=(repl, repl, o_sh, repl, batch_sh, batch_sh,
+                in_shardings=(p_sh, repl, o_sh, repl, batch_sh, batch_sh,
                               repl, batch_sh, batch_sh),
-                out_shardings=(repl, repl, o_sh, repl),
-                donate_argnums=(0, 1, 2)), "parallel/zero_step")
+                out_shardings=(p_sh, repl, o_sh, repl),
+                donate_argnums=(0, 1, 2)),
+                "parallel/zero_tp_step" if two_d else "parallel/zero_step")
         elif self.mode == TrainingMode.SYNC:
+            from .sharding import model_layer_hints
             specs = param_specs(m.params, self.strategy, mesh,
-                                self.model_axis, self.data_axis)
+                                self.model_axis, self.data_axis,
+                                layers=model_layer_hints(m))
             p_sh = jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s), specs,
                 is_leaf=lambda x: isinstance(x, P))
@@ -544,17 +657,28 @@ class ParallelTrainer:
         fn = cache.get(bool(skip_nonfinite))
         if fn is not None:
             return fn
-        if self.strategy in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2):
+        if self.strategy in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2,
+                             ShardingStrategy.ZERO1_TP):
+            from .sharding import model_layer_hints
             from .zero import (DEFAULT_BUCKET_MB, ZeroConfig,
                                make_zero_accum_superstep)
+            two_d = self.strategy == ShardingStrategy.ZERO1_TP
             cfg = ZeroConfig(
-                stage=1 if self.strategy == ShardingStrategy.ZERO1 else 2,
+                stage=2 if self.strategy == ShardingStrategy.ZERO2 else 1,
                 bucket_mb=(DEFAULT_BUCKET_MB if self.zero_bucket_mb is None
                            else self.zero_bucket_mb),
                 reduce_dtype=self.zero_reduce_dtype)
+            base_specs = None
+            if two_d:
+                base_specs = param_specs(
+                    self.model.params, self.strategy, self.mesh,
+                    self.model_axis, self.data_axis,
+                    layers=model_layer_hints(self.model))
             raw, _info = make_zero_accum_superstep(
                 self.model, self.mesh, data_axis=self.data_axis,
-                config=cfg, skip_nonfinite=bool(skip_nonfinite))
+                config=cfg, skip_nonfinite=bool(skip_nonfinite),
+                base_specs=base_specs,
+                model_axis=self.model_axis if two_d else None)
             name = "parallel/zero_accum_superstep"
         else:
             from ..nn.superstep import build_accum_superstep

@@ -100,20 +100,70 @@ def _nontrivial(spec: P) -> bool:
     return any(ax is not None for ax in tuple(spec))
 
 
-def zero_grad_specs(params, mesh: Mesh, data_axis: str = MeshAxes.DATA):
+def _spec_shards(spec: P, mesh: Mesh) -> int:
+    """Number of shards a spec splits a tensor into (product of the named
+    mesh axis sizes; tuple entries multiply)."""
+    n = 1
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            n *= int(mesh.shape[ax])
+    return n
+
+
+def _add_data_axis(spec: P, shape, data_axis: str, mesh: Mesh) -> P:
+    """Extend a (possibly model-sharded) base spec with the ZeRO ``data``
+    axis: the largest FREE dimension divisible by the data-axis size takes
+    it; if every free dim resists, the data axis STACKS onto an
+    already-sharded dim whose per-shard extent still divides (a
+    column-parallel bias [F] sharded over ``model`` becomes
+    P(("model", "data")) — 1/(m·d) per device). Leaves with no divisible
+    home stay at the base spec (their update cost is noise)."""
+    d = int(mesh.shape[data_axis])
+    entries = list(tuple(spec)) + [None] * (len(shape) - len(tuple(spec)))
+    free = [i for i, e in enumerate(entries) if e is None]
+    for ax in sorted(free, key=lambda i: -shape[i]):
+        if shape[ax] % d == 0 and shape[ax] >= d:
+            entries[ax] = data_axis
+            return P(*entries)
+    for ax, e in enumerate(entries):
+        if e is None:
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        per_shard = shape[ax] // int(np.prod([mesh.shape[a] for a in axes]))
+        if per_shard % d == 0 and per_shard >= d:
+            entries[ax] = tuple(axes) + (data_axis,)
+            return P(*entries)
+    return spec
+
+
+def zero_grad_specs(params, mesh: Mesh, data_axis: str = MeshAxes.DATA,
+                    base=None):
     """Per-leaf PartitionSpec pytree sharding each gradient/moment tensor
-    on its largest data-axis-divisible dimension (biases and other tensors
-    with no divisible axis stay replicated — their update cost is noise)."""
-    return jax.tree_util.tree_map(
-        lambda a: _fsdp_spec_for(np.shape(a), data_axis, mesh), params)
+    over the ``data`` axis on its largest divisible dimension (biases and
+    other tensors with no divisible axis stay replicated — their update
+    cost is noise). `base` (a congruent P pytree, e.g. the Megatron TP
+    specs) composes: the data axis lands on a dimension the base spec
+    left free (or stacks onto a sharded one), so ZERO1×TP moments shard
+    over BOTH mesh axes."""
+    if base is None:
+        return jax.tree_util.tree_map(
+            lambda a: _fsdp_spec_for(np.shape(a), data_axis, mesh), params)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    base_leaves = jax.tree_util.tree_leaves(base, is_leaf=_is_p)
+    out = [_add_data_axis(s, np.shape(a), data_axis, mesh)
+           for a, s in zip(leaves, base_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def zero_opt_shardings(opt_state, params, mesh: Mesh,
-                       data_axis: str = MeshAxes.DATA):
+                       data_axis: str = MeshAxes.DATA, base=None):
     """NamedSharding pytree for the optimizer state: each moment tensor
     gets its param's ZeRO shard spec (matched by shape), scalars and
-    unmatched leaves replicated."""
-    specs = zero_grad_specs(params, mesh, data_axis)
+    unmatched leaves replicated. `base` as in `zero_grad_specs`."""
+    specs = zero_grad_specs(params, mesh, data_axis, base=base)
     p_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_p)
     return _opt_sharding_like(opt_state, params, p_sh)
@@ -175,7 +225,8 @@ class _ZeroPlan:
     accounting (`info`) telemetry consumes."""
 
     def __init__(self, model, mesh: Mesh, data_axis: str,
-                 config: ZeroConfig):
+                 config: ZeroConfig, base_specs=None,
+                 model_axis: Optional[str] = None):
         if config.stage not in (1, 2):
             raise ValueError(
                 f"ZeRO stage must be 1 or 2, got {config.stage}")
@@ -186,13 +237,23 @@ class _ZeroPlan:
                 "reduce_dtype (zero_reduce_dtype=) only applies to ZERO2 "
                 "— stage 1 reduces gradients in their own dtype; use "
                 "ShardingStrategy.ZERO2 or drop the knob")
+        if base_specs is not None and config.stage >= 2:
+            # the bucketed reduce-scatter packs FULL-size leaves; on a
+            # model-sharded gradient tree it would reshard over the wrong
+            # axis — stage 2 on a 2-D mesh is future work (ROADMAP item 2)
+            raise ValueError(
+                "ZeRO stage 2 does not compose with tensor-parallel base "
+                "specs yet — use stage 1 (ShardingStrategy.ZERO1_TP)")
         _check_updaters(model)
         self.config = config
 
         # ---- static layout: one spec/sharding per param leaf ------------
         leaves, self.treedef = jax.tree_util.tree_flatten(model.params)
+        base_leaves = (jax.tree_util.tree_leaves(base_specs, is_leaf=_is_p)
+                       if base_specs is not None else [P()] * len(leaves))
         specs = jax.tree_util.tree_leaves(
-            zero_grad_specs(model.params, mesh, data_axis), is_leaf=_is_p)
+            zero_grad_specs(model.params, mesh, data_axis,
+                            base=base_specs), is_leaf=_is_p)
         self.shardings = [NamedSharding(mesh, s) for s in specs]
         shapes = [np.shape(l) for l in leaves]
         counts = [int(np.prod(s, dtype=np.int64)) if s else 1
@@ -200,52 +261,81 @@ class _ZeroPlan:
         itemsize = [np.dtype(jnp.result_type(l)).itemsize for l in leaves]
         red_itemsize = (np.dtype(config.reduce_dtype).itemsize
                         if config.reduce_dtype is not None else None)
+        # per-leaf model-axis shard factor: data-axis collectives on a
+        # model-sharded leaf carry 1/m of the tensor (the 2-D memory/comm
+        # story — payload rides the small axis)
+        m_fac = [_spec_shards(s, mesh) for s in base_leaves]
 
         # buckets pack the REVERSED leaf order: backward produces the last
         # layer's gradients first, so reverse-forward order approximates
         # the order buckets fill in PyTorch DDP
         order = list(range(len(leaves)))[::-1]
-        wire = lambda i: counts[i] * (red_itemsize or itemsize[i])
+        wire = lambda i: counts[i] * (red_itemsize or itemsize[i]) \
+            // m_fac[i]
         self.buckets = [[order[j] for j in b] for b in assign_buckets(
             [wire(i) for i in order], int(config.bucket_mb * (1 << 20)))]
 
-        sharded_idx = [i for i, s in enumerate(specs) if _nontrivial(s)]
+        # "sharded" = the DATA axis was added beyond the base layout;
+        # leaves the data axis could not land on keep the base spec and
+        # are left to in/out-sharding propagation
+        sharded_idx = [i for i, (s, b) in enumerate(zip(specs, base_leaves))
+                       if tuple(s) != tuple(b)]
         self.sharded_set = set(sharded_idx)
         rs_bytes = sum(wire(i) for i in sharded_idx)
         full_bytes = sum(wire(i) for i in range(len(leaves)))
-        ag_bytes = sum(counts[i] * itemsize[i] for i in sharded_idx)
+        ag_bytes = sum(counts[i] * itemsize[i] // m_fac[i]
+                       for i in sharded_idx)
         n_dev = int(mesh.shape[data_axis])
+        m_dev = int(mesh.shape[model_axis]) if model_axis else 1
         # fp32 gradient-accumulator footprint per device: sharded leaves
         # land 1/N per device under ZERO2's post-reduce-scatter layout,
         # vs the full tree when accumulating replicated (the memory story
         # tests/test_accumulation.py and the DP-accum bench assert)
         acc_sharded = sum(
-            (-(-counts[i] // n_dev) if i in self.sharded_set else counts[i])
+            (-(-(counts[i] // m_fac[i]) // n_dev) if i in self.sharded_set
+             else counts[i] // m_fac[i])
             * 4 for i in range(len(leaves)))
         acc_repl = sum(counts[i] * 4 for i in range(len(leaves)))
+        # per-device param + optimizer-moment footprint (the headline the
+        # mesh2d bench reports: moments ~1/(d·m) of the replicated tree)
+        param_local = sum(counts[i] * itemsize[i] // m_fac[i]
+                          for i in range(len(leaves)))
+        moment_local = sum(
+            (counts[i] // m_fac[i]) // (n_dev if i in self.sharded_set
+                                        else 1) * itemsize[i]
+            for i in range(len(leaves)))
         self.info = {
             "stage": config.stage,
             "n_buckets": len(self.buckets) if config.stage >= 2 else 0,
             "sharded_leaves": len(sharded_idx),
             "replicated_leaves": len(leaves) - len(sharded_idx),
             "devices": n_dev,
+            # mesh decomposition of this plan; the declared "bytes" below
+            # all ride the DATA axis (model-axis activation psums belong
+            # to the model's forward/backward, not the optimizer plan)
+            "mesh_axes": {"data": n_dev, "model": m_dev},
+            "collective_axis": data_axis,
             "accum_bytes": {"sharded": acc_sharded,
                             "replicated": acc_repl},
+            "per_device_bytes": {"params": param_local,
+                                 "moments_per_state": moment_local},
             # logical payload per step (what the wire carries, not
-            # ×(N-1)/N)
+            # ×(N-1)/N), on the DATA axis; model-sharded leaves count
+            # their 1/m local shard
             "bytes": ({"reduce_scatter": rs_bytes,
                        "all_reduce": full_bytes - rs_bytes,
                        "all_gather": ag_bytes}
                       if config.stage >= 2 else
                       {"reduce_scatter": 0,
                        "all_reduce": sum(counts[i] * itemsize[i]
+                                         // m_fac[i]
                                          for i in range(len(leaves))),
                        "all_gather": ag_bytes}),
         }
 
         # optimizer-state constraints (same specs, matched by shape)
         opt_sh_tree = zero_opt_shardings(model.updater_state, model.params,
-                                         mesh, data_axis)
+                                         mesh, data_axis, base=base_specs)
         self.opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
         self.opt_treedef = jax.tree_util.tree_structure(model.updater_state)
 
@@ -336,7 +426,8 @@ class _ZeroPlan:
 
 
 def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
-                   config: ZeroConfig = ZeroConfig()
+                   config: ZeroConfig = ZeroConfig(), base_specs=None,
+                   model_axis: Optional[str] = None
                    ) -> Tuple[Any, Dict[str, Any]]:
     """Build the ZeRO train step for `model` (MultiLayerNetwork or
     ComputationGraph).
@@ -349,8 +440,16 @@ def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
     buffers. `info` carries the static per-step accounting the trainer
     feeds telemetry: logical collective payload bytes by op and the
     gradient bucket count.
+
+    2-D composition (ISSUE 14, strategy ``zero1_tp``): `base_specs` is
+    the Megatron TP PartitionSpec tree params live in BETWEEN steps
+    (sharded over `model_axis`). The plan then adds the ``data`` axis on
+    top — moments and the in-step updated params shard over BOTH axes —
+    and the jit's TP param out-sharding makes the trailing allgather ride
+    the DATA axis only (each model group gathers its own 1/m shard).
     """
-    plan = _ZeroPlan(model, mesh, data_axis, config)
+    plan = _ZeroPlan(model, mesh, data_axis, config, base_specs=base_specs,
+                     model_axis=model_axis)
     plan.info["expected_constraints"] = plan.expected_constraints()
     # the model's grad half (loss selection incl. remat + minimize sign)
     grad_fn = model.grad_step_fn
@@ -375,7 +474,9 @@ def make_zero_step(model, mesh: Mesh, *, data_axis: str = MeshAxes.DATA,
 def make_zero_accum_superstep(model, mesh: Mesh, *,
                               data_axis: str = MeshAxes.DATA,
                               config: ZeroConfig = ZeroConfig(),
-                              skip_nonfinite: bool = False
+                              skip_nonfinite: bool = False,
+                              base_specs=None,
+                              model_axis: Optional[str] = None
                               ) -> Tuple[Any, Dict[str, Any]]:
     """The ZeRO ACCUMULATED superstep (ISSUE 12): a nested scan over
     [K, M, batch, ...] windows — outer over K optimizer steps, inner over
@@ -405,7 +506,8 @@ def make_zero_accum_superstep(model, mesh: Mesh, *,
     mirrors the generic builder (zero the bad microbatch's gradient,
     renormalize over the finite ones).
     """
-    plan = _ZeroPlan(model, mesh, data_axis, config)
+    plan = _ZeroPlan(model, mesh, data_axis, config, base_specs=base_specs,
+                     model_axis=model_axis)
     plan.info["expected_constraints"] = plan.expected_constraints(accum=True)
     grad_fn = model.grad_step_fn
     stage2 = config.stage >= 2
@@ -415,9 +517,10 @@ def make_zero_accum_superstep(model, mesh: Mesh, *,
 
         def opt_body(carry, inp):
             params, state, opt, step, rng, token = carry
+            n_micro = jax.tree_util.tree_leaves(inp)[0].shape[0]
 
             def micro_body(mcarry, minp):
-                state, rng, acc, n_ok, ssum, token = mcarry
+                state, rng, acc, n_ok, ssum, token, mbuf, mi = mcarry
                 x, y, f, l = minp
                 rng, k = jax.random.split(rng)
                 score, new_state, grads = grad_fn(params, state, x, y, k,
@@ -446,14 +549,24 @@ def make_zero_accum_superstep(model, mesh: Mesh, *,
                     # keep the running sum pinned to the shard layout —
                     # the accumulator never materializes replicated
                     acc = plan.constrain_acc(acc)
-                return (state, rng, acc, n_ok, ssum, token), score
+                # carried, int32-indexed score buffer (NOT a scan
+                # output): on a 2-D mesh GSPMD shards the scan-output
+                # stacking buffer over an axis dividing M and this XLA
+                # version mis-types the partitioned update (see
+                # nn/superstep.build_accum_superstep)
+                mbuf = jax.lax.dynamic_update_index_in_dim(
+                    mbuf, score.astype(f32), mi, 0)
+                return (state, rng, acc, n_ok, ssum, token, mbuf,
+                        mi + jnp.int32(1)), None
 
             acc0 = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(jnp.shape(p), f32), params)
             if stage2:
                 acc0 = plan.constrain_acc(acc0)
-            (state, rng, acc, n_ok, ssum, token), mscores = jax.lax.scan(
-                micro_body, (state, rng, acc0, f32(0.0), f32(0.0), token),
+            (state, rng, acc, n_ok, ssum, token, mscores,
+             _mi), _ = jax.lax.scan(
+                micro_body, (state, rng, acc0, f32(0.0), f32(0.0), token,
+                             jnp.zeros((n_micro,), f32), jnp.int32(0)),
                 inp)
             denom = jnp.maximum(n_ok, 1.0)
             gmean = jax.tree_util.tree_map(

@@ -33,6 +33,15 @@
 #                            accum-vs-native bench rep on the 8-dev mesh
 #                            (throughput ratio, accumulator memory,
 #                            overlap fraction)
+#   ./runtests.sh mesh2d     2-D mesh-parallelism smoke: the ZERO1×TP
+#                            equivalence suite (vs replicated and 1-D
+#                            ZERO1, superstep/accumulation grouping
+#                            invariance, kill-mid-write resume with 2-D
+#                            layouts, up-front combo validation) plus one
+#                            transformer-block tokens/s bench rep with
+#                            the TP-only / DP×TP / ZERO1×TP paired arms
+#                            (per-device bytes + per-axis collective
+#                            payloads JSON)
 #   ./runtests.sh lint       graftlint, both tiers: the AST pass
 #                            (jit/tracer hygiene, recompile hazards,
 #                            donation safety, concurrency lint) AND the
@@ -85,6 +94,15 @@ if [[ "${1:-}" == "accum" ]]; then
         XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
         --mode accum --steps 2 --reps 2
+fi
+if [[ "${1:-}" == "mesh2d" ]]; then
+    echo "=== 2-D mesh parallelism equivalence smoke ==="
+    python -m pytest tests/test_mesh2d.py -q
+    echo "=== transformer-block mesh2d bench rep (TP vs DPxTP vs ZERO1xTP) ==="
+    exec env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --mode mesh2d --steps 2 --reps 2
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

@@ -1,18 +1,51 @@
 """Child process for the real 2-process distributed test.
 
 Usage: python _dist_child.py <coordinator> <num_procs> <process_id> <outdir>
+       python _dist_child.py --probe <coordinator> <num_procs> <process_id>
 
 Each process owns 4 virtual CPU devices (XLA_FLAGS set by the parent);
 together they form one 8-device global mesh. Trains the same model on the
 same deterministic global batch as the single-process reference and writes
 its view of the final parameters.
+
+`--probe` is the CAPABILITY CHECK (ISSUE 14 satellite): rendezvous, build
+the cross-process mesh and run ONE tiny cross-process psum, asserting the
+globally-reduced value. When the installed jax CPU backend cannot run
+multiprocess collectives, this exits non-zero (or hangs into the parent's
+timeout) — the parent then SKIPS the full suite with an environment
+reason instead of reporting the backend limitation as a red test.
 """
 import sys
 
 import numpy as np
 
 
+def probe(coord, n_procs, pid):
+    """Minimal cross-process collective: must complete quickly on any
+    backend that can run the full suite at all."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n_procs, process_id=pid)
+    assert jax.process_count() == n_procs
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(devs.size), ("data",))
+    local = jnp.ones((len(jax.local_devices()),), jnp.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.asarray(local))
+    total = jax.jit(lambda a: a.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    assert float(total) == devs.size, float(total)
+    print(f"probe proc {pid} ok total={float(total)}")
+
+
 def main():
+    if sys.argv[1] == "--probe":
+        probe(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+        return
     coord, n_procs, pid, outdir = (sys.argv[1], int(sys.argv[2]),
                                    int(sys.argv[3]), sys.argv[4])
     import jax

@@ -1057,6 +1057,86 @@ def test_ir_nondeterministic_reduction_caught():
         [f.render() for f in found]
 
 
+def test_ir_mesh2d_family_clean_and_contracted():
+    """The 2-D (data, model) train-step family (ISSUE 14): the DP×TP and
+    ZERO1×TP steps on both reshapes of the 8-device mesh lint clean, and
+    the ZeRO entries carry the extended per-axis contract (data budget =
+    the plan's declared optimizer payload, model budget = the paired TP
+    step's measured activation traffic, plus the constraint schedule)."""
+    ir, probes = _ir(), _probes()
+    entries = probes.mesh2d_entries()
+    assert {e.name for e in entries} == {
+        "parallel/tp_step_2x4", "parallel/zero1_tp_step_2x4",
+        "parallel/tp_step_4x2", "parallel/zero1_tp_step_4x2"}
+    for e in entries:
+        found = ir.analyze_entry(e)
+        assert not found, [f.render() for f in found]
+        if e.name.startswith("parallel/zero1_tp"):
+            assert e.declared_bytes_by_axis is not None
+            assert e.declared_bytes_by_axis["data"] > 0
+            # the whole-mesh bucket is budgeted too: a rematerialization
+            # gathered over BOTH axes must not escape the byte check
+            assert "other" in e.declared_bytes_by_axis
+            assert e.expected_constraints and e.expected_constraints > 0
+            assert set(e.axis_sizes) == {"data", "model"}
+
+
+def test_ir_mesh2d_dropped_constraint_caught():
+    """Seeded mutation (ISSUE 14 satellite): the 2-D step without its
+    constrain_params/constrain_opt schedule carries fewer traced
+    sharding_constraints than the plan declares — ir-implicit-reshard
+    fires on the constraint half."""
+    ir, probes = _ir(), _probes()
+    entry = probes.mesh2d_zero1_tp_entry((2, 4),
+                                         mutate="drop_constraints")
+    found = ir.analyze_entry(entry)
+    hits = [f for f in found if f.rule == "ir-implicit-reshard"
+            and f.snippet.endswith(":constraints")]
+    assert len(hits) == 1, [f.render() for f in found]
+
+
+def test_ir_mesh2d_dropped_model_axis_caught():
+    """Seeded mutation (ISSUE 14 satellite): constraints that keep their
+    COUNT but lose the `model` axis (data-only specs) force GSPMD to
+    materialize the model-sharded params across the mesh inside the step
+    — the per-axis byte check fires (the full rematerialization lands as
+    excess collective traffic on one of the declared axes)."""
+    ir, probes = _ir(), _probes()
+    tp_entry, model_budget, other_budget = probes._mesh2d_tp_entry((2, 4))
+    entry = probes.mesh2d_zero1_tp_entry((2, 4), model_budget=model_budget,
+                                         other_budget=other_budget,
+                                         mutate="drop_model_axis")
+    found = ir.analyze_entry(entry)
+    hits = [f for f in found if f.rule == "ir-implicit-reshard"
+            and ":bytes:" in f.snippet]
+    assert hits, [f.render() for f in found]
+
+
+def test_ir_per_axis_byte_classification():
+    """measured_collective_bytes_by_axis attributes collectives to mesh
+    axes by replica-group size, parsing BOTH HLO group syntaxes; sizes
+    matching no axis (or an ambiguous d == m pair) land under 'other'."""
+    ir = _ir()
+    text = "\n".join([
+        "  %ar1 = f32[64]{0} all-reduce(f32[64]{0} %p0), "
+        "replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add",
+        "  %ag1 = f32[128]{0} all-gather(f32[32]{0} %p1), "
+        "replica_groups=[2,4]<=[8], dimensions={0}",
+        "  %ar2 = f32[16]{0} all-reduce(f32[16]{0} %p2), "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add",
+    ])
+    by_axis = ir.measured_collective_bytes_by_axis(
+        text, {"data": 2, "model": 4})
+    assert by_axis["data"] == {"all-reduce": 256}       # groups of 2
+    assert by_axis["model"] == {"all-gather": 512}      # groups of 4
+    assert by_axis["other"] == {"all-reduce": 64}       # global (size 8)
+    # ambiguous mesh (d == m): everything falls to "other", so the
+    # per-axis check cannot silently mis-attribute
+    amb = ir.measured_collective_bytes_by_axis(text, {"data": 4,
+                                                      "model": 4})
+    assert "data" not in amb and "model" not in amb
+
+
 def test_ir_redundant_reshard_and_invalid_axis_caught():
     """psum_scatter immediately all-gathered back fires the redundant-
     reshard pair rule (jaxpr AND compiled-text detectors); a collective

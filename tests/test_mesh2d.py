@@ -1,0 +1,370 @@
+"""2-D (data, model) mesh parallelism (ISSUE 14, parallel/zero.py
+ZERO1×TP composition).
+
+The acceptance pattern extends test_zero's: the 2-D composition must be
+PARAMETER-EQUIVALENT (f32-ulp — tensor parallelism reassociates matmul
+partial sums over `model`) to both the replicated baseline and the 1-D
+ZERO1 trainer on the same batch stream, the static layouts must actually
+land on the mesh (params 1/m, moments ~1/(d·m) per device, measured from
+the device buffers), grouping under superstep/grad_accumulation must not
+change the math, and the fault plane must compose (kill mid-sharded-save,
+resume, 2-D layouts re-landing). The unsupported 2-D combinations must be
+rejected up front with one actionable message.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer,
+                                EmbeddingSequenceLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer,
+                                TransformerBlock)
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.fault.injection import SimulatedCrash, crash_at_write
+from deeplearning4j_tpu.parallel import (ParallelTrainer, ShardedCheckpoint,
+                                         ShardingStrategy, TrainingMode,
+                                         make_mesh)
+
+pytestmark = pytest.mark.sanitize
+
+
+def _model(seed=7, hidden=16):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _transformer_lm(seed=0, vocab=32, width=16, t=8):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width))
+            .layer(TransformerBlock(n_heads=4))
+            .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, t))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, n)]
+    return x, y
+
+
+def _iter(n=64, batch=16, seed=0):
+    x, y = _data(n, seed)
+    return ArrayDataSetIterator(x, y, batch_size=batch, shuffle=False)
+
+
+def _flat(model):
+    return np.asarray(model.params_flat())
+
+
+def _train(tr, steps=5, seed=0):
+    x, y = _data(64, seed)
+    ds = DataSet(x, y)
+    for _ in range(steps):
+        tr.fit(ds)
+    return tr
+
+
+def _specs(tree):
+    return [tuple(l.sharding.spec) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _axes_used(spec):
+    out = set()
+    for e in spec:
+        if e is None:
+            continue
+        out.update(e if isinstance(e, tuple) else (e,))
+    return out
+
+
+def _local_bytes(tree):
+    """Actually-resident bytes on device 0 (one shard per leaf)."""
+    total = 0
+    for l in jax.tree_util.tree_leaves(tree):
+        total += l.addressable_shards[0].data.nbytes
+    return total
+
+
+# ======================================================================
+# equivalence: ZERO1×TP == replicated == 1-D ZERO1 on the same stream
+# ======================================================================
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_zero1_tp_matches_replicated_and_1d_zero1(shape):
+    ref = _train(ParallelTrainer(_model(), mesh=make_mesh({"data": 8})))
+    z1 = _train(ParallelTrainer(_model(), mesh=make_mesh({"data": 8}),
+                                strategy=ShardingStrategy.ZERO1))
+    tp = _train(ParallelTrainer(_model(), mesh_shape=shape,
+                                strategy=ShardingStrategy.ZERO1_TP))
+    p_ref, p_z1, p_tp = (_flat(t.publish_view()) for t in (ref, z1, tp))
+    np.testing.assert_allclose(p_tp, p_ref, rtol=2e-6, atol=1e-7)
+    np.testing.assert_allclose(p_tp, p_z1, rtol=2e-6, atol=1e-7)
+    # gathered moments equal the replicated trainer's too
+    ro = [np.asarray(l) for l in jax.tree_util.tree_leaves(ref._opt)]
+    zo = [np.asarray(l) for l in jax.tree_util.tree_leaves(tp._opt)]
+    assert len(ro) == len(zo)
+    for a, b in zip(zo, ro):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+def test_zero1_tp_layouts_land_on_both_axes():
+    tr = _train(ParallelTrainer(_model(), mesh_shape=(2, 4),
+                                strategy=ShardingStrategy.ZERO1_TP), 2)
+    # params live MODEL-sharded between steps (Megatron layout), never
+    # data-sharded
+    p_axes = set().union(*(_axes_used(s) for s in _specs(tr._params)))
+    assert p_axes == {"model"}
+    assert not tr.params_replicated
+    # moments shard over BOTH axes (data added on top of the model base)
+    o_axes = set().union(*(_axes_used(s) for s in _specs(tr._opt)))
+    assert o_axes == {"data", "model"}
+
+
+def test_zero1_tp_per_device_moment_bytes_one_over_dm():
+    """The memory headline: per-device optimizer-moment bytes ~1/(d·m) of
+    the replicated tree — measured from the actual device buffers, and
+    matching the plan's static accounting."""
+    tr = _train(ParallelTrainer(_model(), mesh_shape=(2, 4),
+                                strategy=ShardingStrategy.ZERO1_TP), 2)
+    repl = _train(ParallelTrainer(_model(), mesh=make_mesh({"data": 8})), 2)
+    got = _local_bytes(tr._opt)
+    full = _local_bytes(repl._opt)
+    # 1/8 plus slack for the handful of leaves the data axis cannot
+    # divide (the 4-wide output bias)
+    assert got <= full * (1 / 8 + 0.05), (got, full)
+    # params: 1/m per device
+    assert _local_bytes(tr._params) == pytest.approx(
+        _local_bytes(repl._params) / 4, rel=0.05)
+    info = tr.collective_accounting()
+    assert info["mesh_axes"] == {"data": 2, "model": 4}
+    # static accounting matches the measured buffers: Adam carries two
+    # param-congruent state trees (m, v)
+    assert 2 * info["per_device_bytes"]["moments_per_state"] \
+        == pytest.approx(got, rel=0.05)
+
+
+def test_transformer_block_dp_tp_matches_replicated():
+    """The flagship scenario (ISSUE 14 / ROADMAP item 5): the GPT-style
+    transformer block trains DP×TP on the (2, 4) mesh parameter-
+    equivalent to the single-mesh replicated run — Megatron column/row
+    rules + the vocab-sharded embedding propagate through attention and
+    both projections without perturbing the math. Tolerance is a few
+    f32-ulp looser than the MLP assertion: the sharded attention
+    reassociates softmax/rsqrt reductions and Adam's 1/sqrt(v) amplifies
+    the last bits over the 3 steps."""
+    r = np.random.default_rng(0)
+    x = r.integers(0, 32, (16, 8, 1)).astype(np.float32)
+    y = np.eye(32, dtype=np.float32)[r.integers(0, 32, (16, 8))]
+    ds = DataSet(x, y)
+
+    ref = ParallelTrainer(_transformer_lm(), mesh=make_mesh({"data": 8}))
+    tp = ParallelTrainer(_transformer_lm(), mesh_shape=(2, 4),
+                         strategy=ShardingStrategy.TENSOR_PARALLEL)
+    ztp = ParallelTrainer(_transformer_lm(), mesh_shape=(2, 4),
+                          strategy=ShardingStrategy.ZERO1_TP)
+    for _ in range(3):
+        ref.fit(ds)
+        tp.fit(ds)
+        ztp.fit(ds)
+    p_ref = _flat(ref.publish_view())
+    np.testing.assert_allclose(_flat(tp.publish_view()), p_ref,
+                               rtol=1e-3, atol=5e-5)
+    np.testing.assert_allclose(_flat(ztp.publish_view()), p_ref,
+                               rtol=1e-3, atol=5e-5)
+    # Megatron roles landed: vocab-sharded embedding, column-parallel
+    # QKV/FFN-in, row-parallel out-projections, replicated LayerNorm
+    flat, _ = jax.tree_util.tree_flatten_with_path(tp._params)
+    by_key = {(p[0].idx, str(p[-1].key)): tuple(l.sharding.spec)
+              for p, l in flat}
+    assert by_key[(0, "W")][0] == "model"               # vocab axis
+    by_key = {k: s for (_i, k), s in by_key.items() if _i == 1}
+    assert by_key["W_q"] == (None, "model")
+    assert by_key["W_o"] == ("model", None)
+    assert by_key["W_ffn_in"] == (None, "model")
+    assert by_key["W_ffn_out"] == ("model", None)
+    assert _axes_used(by_key["ln1_g"]) == set()
+
+
+# ======================================================================
+# grouping invariance: superstep / grad_accumulation compose unchanged
+# ======================================================================
+
+def test_zero1_tp_superstep_grouping_bitexact():
+    base = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                           strategy=ShardingStrategy.ZERO1_TP)
+    base.fit(_iter(96), epochs=2)
+    sup = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                          strategy=ShardingStrategy.ZERO1_TP)
+    sup.fit(_iter(96), epochs=2, superstep=3)
+    np.testing.assert_allclose(_flat(sup.publish_view()),
+                               _flat(base.publish_view()), rtol=0, atol=0)
+
+
+def test_zero1_tp_grad_accumulation_grouping_bitexact():
+    a = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                        strategy=ShardingStrategy.ZERO1_TP)
+    a.fit(_iter(96), epochs=2, grad_accumulation=2)
+    b = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                        strategy=ShardingStrategy.ZERO1_TP)
+    b.fit(_iter(96), epochs=2, grad_accumulation=2, superstep=2)
+    assert a.iteration_count == b.iteration_count == 6
+    np.testing.assert_allclose(_flat(b.publish_view()),
+                               _flat(a.publish_view()), rtol=0, atol=0)
+
+
+def test_zero1_tp_accumulation_matches_big_batch():
+    """M microbatches of b == one native batch of M·b (f32-ulp: XLA
+    reassociates the batch reduction) — the accumulation contract holds
+    through the 2-D step."""
+    acc = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                          strategy=ShardingStrategy.ZERO1_TP)
+    acc.fit(_iter(64, batch=16), epochs=1, grad_accumulation=4)
+    big = ParallelTrainer(_model(), mesh_shape=(2, 4),
+                          strategy=ShardingStrategy.ZERO1_TP)
+    big.fit(_iter(64, batch=64), epochs=1)
+    assert acc.iteration_count == big.iteration_count == 1
+    np.testing.assert_allclose(_flat(acc.publish_view()),
+                               _flat(big.publish_view()),
+                               rtol=2e-6, atol=1e-7)
+
+
+# ======================================================================
+# fault plane: kill mid-sharded-save, resume with 2-D layouts
+# ======================================================================
+
+def test_kill_mid_sharded_save_resume_relands_2d_layouts(tmp_path):
+    mk = lambda: ParallelTrainer(_model(), mesh_shape=(2, 4),
+                                 strategy=ShardingStrategy.ZERO1_TP)
+    ref = mk()
+    ref.fit(_iter(), epochs=2)
+    ref_params = _flat(ref.publish_view())
+
+    d = str(tmp_path / "ck")
+    tr1 = mk()
+    with crash_at_write("sharded/tree_written", nth=2):
+        with pytest.raises(SimulatedCrash):
+            tr1.fit(_iter(), epochs=2, checkpoint_dir=d, checkpoint_every=2)
+    mgr = ShardedCheckpoint(d)
+    assert mgr.latest_step() is not None
+
+    tr2 = mk()
+    tr2.fit(_iter(), epochs=2, checkpoint_dir=d, checkpoint_every=2,
+            resume=True)
+    assert tr2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(_flat(tr2.publish_view()), ref_params,
+                               rtol=1e-12)
+    # the restored layouts re-land 2-D on the mesh: params model-sharded,
+    # moments (data, model)-sharded
+    assert set().union(*(_axes_used(s) for s in _specs(tr2._params))) \
+        == {"model"}
+    assert set().union(*(_axes_used(s) for s in _specs(tr2._opt))) \
+        == {"data", "model"}
+
+
+# ======================================================================
+# up-front mode × strategy × mesh_shape validation
+# ======================================================================
+
+@pytest.mark.parametrize("strategy,hint", [
+    (ShardingStrategy.ZERO1, "zero1_tp"),
+    (ShardingStrategy.ZERO2, "zero1_tp"),
+    (ShardingStrategy.FSDP, "zero1_tp"),
+])
+def test_2d_mesh_rejects_1d_sharded_strategies(strategy, hint):
+    with pytest.raises(ValueError, match=hint):
+        ParallelTrainer(_model(), mesh_shape=(2, 4), strategy=strategy)
+
+
+def test_2d_mesh_rejects_averaging():
+    with pytest.raises(ValueError, match="2-D mesh"):
+        ParallelTrainer(_model(), mesh=make_mesh({"data": 2, "model": 4}),
+                        mode=TrainingMode.AVERAGING)
+
+
+@pytest.mark.parametrize("strategy", [ShardingStrategy.TENSOR_PARALLEL,
+                                      ShardingStrategy.ZERO1_TP])
+def test_tp_strategies_reject_mesh_without_model_axis(strategy):
+    with pytest.raises(ValueError, match="mesh_shape"):
+        ParallelTrainer(_model(), mesh=make_mesh({"data": 8}),
+                        strategy=strategy)
+
+
+def test_mesh_shape_knob_validation():
+    with pytest.raises(ValueError, match="not both"):
+        ParallelTrainer(_model(), mesh=make_mesh({"data": 8}),
+                        mesh_shape=(2, 4))
+    with pytest.raises(ValueError, match=r"\(data, model\)"):
+        ParallelTrainer(_model(), mesh_shape=(2, 2, 2))
+
+
+def test_transformer_rejects_indivisible_head_count():
+    """A head count the model axis does not divide would silently
+    reshard inside attention (the QKV reshape stops being a local view)
+    — rejected up front via the layer's tp_validate hook."""
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=32, n_out=24))
+            .layer(TransformerBlock(n_heads=6))
+            .layer(RnnOutputLayer(n_out=32, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, 8))
+            .build())
+    with pytest.raises(ValueError, match="n_heads"):
+        ParallelTrainer(MultiLayerNetwork(conf).init(), mesh_shape=(2, 4),
+                        strategy=ShardingStrategy.ZERO1_TP)
+
+
+def test_zero1_tp_rejects_stage2_knobs():
+    with pytest.raises(ValueError, match="zero_bucket_mb"):
+        ParallelTrainer(_model(), mesh_shape=(2, 4),
+                        strategy=ShardingStrategy.ZERO1_TP,
+                        zero_bucket_mb=1.0)
+    with pytest.raises(ValueError, match="zero_reduce_dtype"):
+        ParallelTrainer(_model(), mesh_shape=(2, 4),
+                        strategy=ShardingStrategy.ZERO1_TP,
+                        zero_reduce_dtype="bfloat16")
+
+
+def test_zero_stage2_with_base_specs_rejected_in_zero_py():
+    """The library-level guard under the trainer validation: stage 2 +
+    TP base specs is an explicit error, not a silent mis-sharding."""
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.sharding import (param_specs,
+                                                      model_layer_hints)
+    from deeplearning4j_tpu.parallel.zero import ZeroConfig, make_zero_step
+
+    m = _model()
+    mesh = make_mesh({"data": 2, "model": 4})
+    base = param_specs(m.params, ShardingStrategy.ZERO1_TP, mesh,
+                       layers=model_layer_hints(m))
+    with pytest.raises(ValueError, match="stage 2"):
+        make_zero_step(m, mesh, config=ZeroConfig(stage=2),
+                       base_specs=base, model_axis="model")
+
+
+def test_score_and_evaluate_compose_spmd():
+    """score(ds)/evaluate run SPMD with the TP shardings (no host gather
+    of a sharded model); the ragged path raises the actionable error."""
+    tr = _train(ParallelTrainer(_model(), mesh_shape=(2, 4),
+                                strategy=ShardingStrategy.ZERO1_TP), 2)
+    x, y = _data(64)
+    s = tr.score(DataSet(x, y))
+    assert np.isfinite(s)
+    ev = tr.evaluate(DataSet(x, y))
+    assert 0.0 <= ev.accuracy() <= 1.0
+    with pytest.raises(ValueError, match="divisible"):
+        tr.score(DataSet(x[:63], y[:63]))

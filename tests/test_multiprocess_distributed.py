@@ -16,6 +16,10 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: cached capability-probe verdict: None = not probed yet, else
+#: (supported: bool, reason: str)
+_CAPABILITY = None
+
 
 def _free_port():
     with socket.socket() as s:
@@ -23,14 +27,73 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training_matches_single_process(tmp_path):
-    port = _free_port()
-    coord = f"127.0.0.1:{port}"
+def _child_env():
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def multiprocess_collectives_supported():
+    """Explicit capability detection (ISSUE 14 satellite): run the tiny
+    `--probe` rendezvous+psum pair from tests/_dist_child.py once per
+    session and cache the verdict. Some jax CPU builds accept
+    `jax.distributed.initialize` but cannot actually execute
+    cross-process collectives (they fail inside dispatch or hang) — on
+    those environments the full 2-process suite is an ENVIRONMENT limit,
+    not a regression, and must read as a skip with this reason instead
+    of a red test. Set DL4J_FORCE_DIST_TESTS=1 to bypass the probe and
+    run the suite regardless (e.g. while debugging the probe itself)."""
+    global _CAPABILITY
+    if os.environ.get("DL4J_FORCE_DIST_TESTS"):
+        return True, "forced by DL4J_FORCE_DIST_TESTS"
+    if _CAPABILITY is not None:
+        return _CAPABILITY
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    child = os.path.join(REPO, "tests", "_dist_child.py")
+    procs = [subprocess.Popen(
+        [sys.executable, child, "--probe", coord, "2", str(pid)],
+        env=_child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for pid in (0, 1)]
+    outs, ok = [], True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n[probe timed out]"
+            ok = False
+        outs.append(out)
+        ok = ok and p.returncode == 0
+    if ok:
+        _CAPABILITY = (True, "probe passed")
+    else:
+        # quote the FAILING process's output (either may be the one that
+        # hit the backend limit; the healthy one just prints "ok")
+        bad = next((o for p, o in zip(procs, outs) if p.returncode != 0),
+                   outs[0])
+        tail = (bad or "")[-300:].replace("\n", " | ")
+        _CAPABILITY = (False,
+                       "jax CPU backend lacks multiprocess collectives in "
+                       f"this environment (capability probe failed: {tail})")
+    return _CAPABILITY
+
+
+def _require_multiprocess_collectives():
+    ok, reason = multiprocess_collectives_supported()
+    if not ok:
+        pytest.skip(reason)
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single_process(tmp_path):
+    _require_multiprocess_collectives()
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = _child_env()
     child = os.path.join(REPO, "tests", "_dist_child.py")
     procs = [subprocess.Popen(
         [sys.executable, child, coord, "2", str(pid), str(tmp_path)],

@@ -53,11 +53,12 @@ def _case(name):
     from deeplearning4j_tpu.nn.layers import (
         ActivationLayer, AutoEncoder, BatchNormalization,
         CenterLossOutputLayer, Convolution1DLayer, ConvolutionLayer,
-        DropoutLayer, EmbeddingLayer, GlobalPoolingLayer,
-        GravesBidirectionalLSTM, GravesLSTM, LastTimeStep,
-        LocalResponseNormalization, LossLayer, MixtureOfExpertsLayer,
-        RnnOutputLayer, Subsampling1DLayer, SubsamplingLayer,
-        VariationalAutoencoder, ZeroPaddingLayer)
+        DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+        GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM,
+        LastTimeStep, LocalResponseNormalization, LossLayer,
+        MixtureOfExpertsLayer, RnnOutputLayer, Subsampling1DLayer,
+        SubsamplingLayer, TransformerBlock, VariationalAutoencoder,
+        ZeroPaddingLayer)
     from deeplearning4j_tpu.nn.layers import RBM
 
     ff = InputType.feed_forward(12)
@@ -134,6 +135,15 @@ def _case(name):
         "GlobalPoolingLayer": lambda: (
             [ConvolutionLayer(n_out=4, kernel_size=(3, 3)),
              GlobalPoolingLayer(), head], conv, cx),
+        "TransformerBlock": lambda: (
+            [TransformerBlock(n_heads=2), rnn_head],
+            InputType.recurrent(8, 6), _rnn_data(f=8)),
+        "EmbeddingSequenceLayer": lambda: (
+            [EmbeddingSequenceLayer(n_in=20, n_out=8), rnn_head],
+            InputType.recurrent(1, 6),
+            (np.random.default_rng(0).integers(
+                0, 20, (8, 6, 1)).astype(np.float32),
+             _rnn_data()[1])),
     }
     thunk = table.get(name)
     return thunk() if thunk else None
