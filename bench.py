@@ -395,16 +395,35 @@ def main():
     except Exception as e:
         extras["Serving-latency"] = f"error: {type(e).__name__}"
     try:
+        # pipeline parallelism (ISSUE 15): the transformer LM trained
+        # mesh-native 1F1B vs host-GPipe vs ZERO1×TP in alternating
+        # paired windows — tokens/s per arm, the paired
+        # 1F1B-vs-host-GPipe throughput ratio (gate > 1: the single
+        # compiled schedule must beat the per-stage dispatch storm),
+        # structural dispatches per optimizer step, compile counts, and
+        # the 3-D step's per-axis compiled-HLO collective payloads
+        # (permutes must ride `pipe` only)
         pipe = bench_pipeline(8)
         if pipe:
-            extras["Pipeline-GPipe-S4"] = {
-                "microbatches": pipe["microbatches"],
-                "bubble_theory": pipe["bubble_theory"],
-                "bubble_measured": pipe["spmd_tick"]["bubble_measured"],
-                "per_tick_ms": pipe["spmd_tick"]["per_tick_ms"],
-                "network_step_ms": pipe["network"]["step_ms"],
-                "graph_step_ms": pipe["graph"]["step_ms"],
-            }
+            f1b = pipe["f1b"]
+            extras["Pipeline-1f1b-tokens-per-s"] = {
+                "arms": {name: arm["tokens_per_s"]
+                         for name, arm in f1b["arms"].items()},
+                "dispatch_span_share": {
+                    name: arm.get("dispatch_span_share")
+                    for name, arm in f1b["arms"].items()},
+                "f1b_vs_host_gpipe_paired": f1b.get(
+                    "f1b_vs_host_gpipe_paired"),
+                "f1b_vs_host_gpipe_spread": f1b.get(
+                    "f1b_vs_host_gpipe_spread"),
+                "dispatches_per_step": f1b.get("dispatches_per_step"),
+                "compiles": f1b.get("compiles"),
+                "collective_bytes_by_axis": f1b.get(
+                    "collective_bytes_by_axis"),
+                "permute_leak_bytes_off_pipe": f1b.get(
+                    "permute_leak_bytes_off_pipe"),
+                "bubble_theory": pipe.get("bubble_theory"),
+                "gate": pipe.get("gate")}
     except Exception:
         pass
     try:

@@ -253,6 +253,50 @@ def measured_collective_bytes(hlo_text: str) -> Dict[str, int]:
 
 _FIRST_GROUP = re.compile(r"\{(\d+(?:\s*,\s*\d+)*)\}")
 _IOTA_GROUPS = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_PERMUTE_PAIRS = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+
+
+def _permute_axis(line: str, axis_items) -> Optional[str]:
+    """Mesh-axis attribution for a collective-permute: its
+    `source_target_pairs` connect LINEAR device ids, so unraveling each
+    (src, dst) against the mesh shape (axis_items = ordered
+    (name, size) pairs, mesh-major order — the order `make_mesh` builds)
+    names the axis every pair moves along. Pipeline stage handoffs shift
+    exactly one coordinate (the `pipe` axis); a permute whose pairs move
+    along a different single axis is a LEAK the per-axis budgets catch,
+    and multi-axis pairs (GSPMD reshard shuffles) land under "other".
+    Returns None when the line carries no pairs."""
+    m = _PERMUTE_PAIRS.search(line)
+    if not m:
+        return None
+    shape = [int(s) for _, s in axis_items]
+    names = [n for n, _ in axis_items]
+    total = 1
+    for s in shape:
+        total *= s
+
+    def unravel(idx):
+        coords = []
+        for s in reversed(shape):
+            idx, c = divmod(idx, s)
+            coords.append(c)
+        return coords[::-1]
+
+    axes = set()
+    for pm in re.finditer(r"\{(\d+),(\d+)\}", m.group(1)):
+        a, b = int(pm.group(1)), int(pm.group(2))
+        if a == b:
+            continue    # identity legs of a reshard shuffle
+        if not shape or a >= total or b >= total:
+            return "other"
+        ca, cb = unravel(a), unravel(b)
+        diff = [i for i in range(len(shape)) if ca[i] != cb[i]]
+        if len(diff) != 1:
+            return "other"
+        axes.add(diff[0])
+    if len(axes) == 1:
+        return names[axes.pop()]
+    return "other"
 
 
 def _replica_group_size(line: str) -> Optional[int]:
@@ -280,13 +324,20 @@ def measured_collective_bytes_by_axis(hlo_text: str,
     (on a (2, 4) mesh, groups of 2 ride "data", groups of 4 ride
     "model"). Collectives whose group size matches no axis — or matches
     more than one (d == m; use distinct sizes for checkable meshes) —
-    land under "other". This is how the IR tier verifies the 2-D
-    contract: ZeRO's optimizer collectives must ride the data axis at
-    the plan's declared payload, and the model axis must carry only the
-    Megatron activation psums."""
+    land under "other". Collective-PERMUTEs carry no replica groups;
+    their `source_target_pairs` are unraveled against the mesh shape
+    instead (`_permute_axis` — `axis_sizes` must list the axes in MESH
+    order, as `make_mesh` builds them), so a pipeline stage handoff
+    attributes to `pipe` and a permute leaking onto `data`/`model`
+    attributes there even when axis sizes collide. This is how the IR
+    tier verifies the 2-D/3-D contract: ZeRO's optimizer collectives
+    must ride the data axis at the plan's declared payload, the model
+    axis must carry only the Megatron activation psums, and only the
+    pipe axis may carry stage handoffs."""
     inverse: Dict[int, List[str]] = {}
     for ax, n in axis_sizes.items():
         inverse.setdefault(int(n), []).append(ax)
+    items = list(axis_sizes.items())
     out: Dict[str, Dict[str, int]] = {}
     for ln in hlo_text.splitlines():
         m = _INSTR.search(ln)
@@ -296,9 +347,12 @@ def measured_collective_bytes_by_axis(hlo_text: str,
         if suffix == "-done":
             continue
         b = _shape_bytes(operands if op == "reduce-scatter" else shape)
-        gsize = _replica_group_size(ln)
-        axes = inverse.get(gsize, []) if gsize is not None else []
-        ax = axes[0] if len(axes) == 1 else "other"
+        if op == "collective-permute":
+            ax = _permute_axis(ln, items) or "other"
+        else:
+            gsize = _replica_group_size(ln)
+            axes = inverse.get(gsize, []) if gsize is not None else []
+            ax = axes[0] if len(axes) == 1 else "other"
         bucket = out.setdefault(ax, {})
         bucket[op] = bucket.get(op, 0) + b
     return out

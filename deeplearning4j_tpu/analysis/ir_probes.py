@@ -29,7 +29,8 @@ from .ir import IrEntry
 
 __all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
            "parallel_entries", "zero_accum_entry", "mesh2d_entries",
-           "mesh2d_zero1_tp_entry", "serving_entries", "virtual_mesh"]
+           "mesh2d_zero1_tp_entry", "pp_entry", "pp_entries",
+           "serving_entries", "virtual_mesh"]
 
 
 def virtual_mesh():
@@ -403,6 +404,160 @@ def mesh2d_entries() -> List[IrEntry]:
     return entries
 
 
+def _pp_stack_model(depth: int, hidden: int = 8, seed: int = 0):
+    """Uniform Dense(hidden->hidden) stack + softmax head: the minimal
+    homogeneous-run model the PipelinePlan stages (input width == hidden
+    so every Dense layer is stackable)."""
+    from .. import (Adam, DenseLayer, InputType, MultiLayerNetwork,
+                    NeuralNetConfiguration, OutputLayer)
+
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+         .list())
+    for _ in range(depth):
+        b = b.layer(DenseLayer(n_out=hidden, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_out=4, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(hidden)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _pp_build(shape: Tuple[int, int, int], zero: bool, M: int, B: int,
+              mutate: Optional[str] = None, hidden: int = 8,
+              tp: Optional[bool] = None):
+    """Assemble the 1F1B accumulated-superstep jit + args on a 3-D
+    (data, model, pipe) mesh, exactly as ParallelTrainer jits it.
+    Returns (jitted_unwrapped, args, info, mesh)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MeshAxes, make_mesh
+    from ..parallel.pipeline import PipelinePlan, make_pp_accum_superstep
+    from ..parallel.sharding import _opt_sharding_like
+    from ..telemetry.compile_watch import watch_compiles
+
+    d, m, p = shape
+    tp = zero if tp is None else tp
+    mesh = make_mesh({MeshAxes.DATA: d, MeshAxes.MODEL: m,
+                      MeshAxes.PIPE: p})
+    model = _pp_stack_model(depth=p, hidden=hidden)
+    plan = PipelinePlan(model, mesh, tp=tp)
+    params_pp = plan.stack(model.params)
+    state_pp = plan.stack(model.state)
+    opt_pp = plan.stack(model.updater_state)
+    p_specs = plan.param_specs()
+    p_sh = plan.shardings(p_specs)
+    s_sh = plan.shardings(plan.state_specs())
+    zero_plan = None
+    if zero:
+        from ..parallel.zero import ZeroConfig, _ZeroPlan
+        zero_plan = _ZeroPlan(model, mesh, MeshAxes.DATA,
+                              ZeroConfig(stage=1), base_specs=p_specs,
+                              model_axis=MeshAxes.MODEL,
+                              params=params_pp, opt_state=opt_pp)
+        o_sh = zero_plan.opt_shardings_tree
+    else:
+        o_sh = _opt_sharding_like(opt_pp, params_pp, p_sh)
+    fn, info = make_pp_accum_superstep(model, plan, zero_plan=zero_plan,
+                                       mutate=mutate)
+    repl = NamedSharding(mesh, P())
+    win = NamedSharding(mesh, P(None, None, MeshAxes.DATA))
+    name = ("zero1_tp_pp" if zero else "pp") + f"_step_{d}x{m}x{p}"
+    jitted = watch_compiles(jax.jit(
+        fn,
+        in_shardings=(p_sh, s_sh, o_sh, repl, repl, win, win, win, win),
+        out_shardings=(p_sh, s_sh, o_sh, repl, repl, repl),
+        donate_argnums=(0, 1, 2)),
+        f"analysis/ir_probe:{name}").__wrapped__
+    xs = jnp.zeros((1, M, B, hidden), jnp.float32)
+    ys = jnp.asarray(jnp.broadcast_to(
+        jnp.eye(4, dtype=jnp.float32)[jnp.arange(B) % 4], (1, M, B, 4)))
+    args = (jax.device_put(params_pp, p_sh),
+            jax.device_put(state_pp, s_sh),
+            jax.device_put(opt_pp, o_sh),
+            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+            xs, ys, None, None)
+    return jitted, args, info, mesh
+
+
+def pp_entry(shape: Tuple[int, int, int] = (1, 1, 8), *, zero: bool = False,
+             M: int = 8, B: int = 32, mutate: Optional[str] = None,
+             budgets: Optional[dict] = None,
+             budget_from_plan: bool = False) -> IrEntry:
+    """The 1F1B step family on a (data, model, pipe) mesh, carrying the
+    pipeline contract: the declared `with_sharding_constraint` schedule
+    (the 1F1B builder's buffer constraints + the ZeRO plan's shard
+    constraints) and optional per-AXIS byte budgets — the `data` budget
+    is the ZeRO plan's declared optimizer payload, `model`/`other` come
+    from the PAIRED no-ZeRO build (`pp_entries`), and the `pipe` axis is
+    deliberately unbudgeted (stage handoffs ride it by design). Public
+    so tests can seed mutations through the same builder:
+
+      mutate="drop_stage_constraint"  the step emits NO buffer sharding
+                                      constraints — the traced count
+                                      falls below the declared schedule
+      mutate="permute_data_axis"      the injection buffer is
+                                      additionally rolled along its
+                                      data-sharded row axis before the
+                                      ring scan (a halo exchange) — a
+                                      collective-permute leaking onto
+                                      `data` that blows that axis's
+                                      byte budget
+    """
+    d, m, p = shape
+    jitted, args, info, mesh = _pp_build(shape, zero, M, B, mutate=mutate)
+    kind = "zero1_tp_pp" if zero else "pp"
+    entry = IrEntry(
+        f"parallel/{kind}_step_{d}x{m}x{p}", "parallel/pipeline.py",
+        fn=jitted, args=args, mesh_axes=tuple(mesh.axis_names),
+        expected_constraints=info["expected_constraints"])
+    if budget_from_plan and zero:
+        budgets = dict(budgets or {})
+        budgets["data"] = sum(info["zero"]["bytes"].values())
+    if budgets is not None:
+        entry.axis_sizes = {"data": d, "model": m, "pipe": p}
+        entry.declared_bytes_by_axis = dict(budgets)
+        # the data bucket carries GSPMD's activation-buffer staging
+        # gathers on top of the plan's declared optimizer payload — a
+        # wider slack than the scan-free 2-D steps, still far below the
+        # ~Nx a replicated stage-param materialization would cost
+        entry.byte_slack = 2.0
+    return entry
+
+
+def pp_entries() -> List[IrEntry]:
+    """The 1F1B roster (ISSUE 15): the pure pipeline on (1, 1, 8) with
+    hard zero budgets on `data`/`model` (no traffic may ride them at
+    all — d = m = 1), and the ZERO1×TP×PP composition on both
+    distinct-size reshapes (2, 1, 4) and (1, 2, 4) — the data budget
+    from the ZeRO plan's declared accounting, the model/other budgets
+    from the PAIRED no-ZeRO build of the identical step (ZeRO-1 adds
+    only data-axis optimizer traffic, so anything extra on `model` is a
+    resharded stage/TP param). The `pipe` axis stays unbudgeted: stage
+    handoffs ride it by design."""
+    from .ir import measured_collective_bytes_by_axis
+
+    entries: List[IrEntry] = []
+    entries.append(pp_entry((1, 1, 8),
+                            budgets={"data": 0, "model": 0}))
+    for shape in ((2, 1, 4), (1, 2, 4)):
+        d, m, p = shape
+        # the paired arm: the IDENTICAL TP×PP step without the ZeRO
+        # plan — its model/other traffic is the legitimate Megatron
+        # boundary payload the ZeRO entry may not exceed
+        jitted, args, _info, _mesh = _pp_build(shape, False, 8, 32,
+                                               tp=True)
+        text = jitted.trace(*args).lower().compile().as_text()
+        by_axis = measured_collective_bytes_by_axis(
+            text, {"data": d, "model": m, "pipe": p})
+        paired = {ax: sum(ops.values()) for ax, ops in by_axis.items()}
+        entries.append(pp_entry(
+            shape, zero=True, budget_from_plan=True,
+            budgets={"model": paired.get("model", 0),
+                     "other": paired.get("other", 0)}))
+    return entries
+
+
 def serving_entries() -> List[IrEntry]:
     """The serving plane's AOT executables: register a tiny model, then
     audit exactly the compiled runners request threads will invoke."""
@@ -425,6 +580,7 @@ def build_entries() -> List[IrEntry]:
     entries += graph_entries()
     entries += parallel_entries()
     entries.append(zero_accum_entry())
+    entries += pp_entries()
     entries += mesh2d_entries()
     entries += serving_entries()
     return entries

@@ -1,16 +1,38 @@
 """Pipeline parallelism over a mesh axis.
 
 NEW capability relative to the reference (SURVEY.md §2.4: pipeline parallelism
-absent). GPipe-style SPMD pipeline in the idiomatic JAX form: stage params are
-stacked on a leading axis sharded over "pipe"; microbatch activations tick
-through the ring with `jax.lax.ppermute` inside `shard_map`. The whole
-schedule (bubble included) is one differentiable traced program, so the
-backward pipeline comes from `jax.grad` — no hand-written 1F1B scheduler.
+absent). Two generations live here:
+
+**Mesh-native 1F1B (ISSUE 15, the production path).** `PipelinePlan` +
+`make_pp_step`/`make_pp_accum_superstep` compile an ENTIRE M-microbatch
+optimizer step into ONE SPMD program on a (data, model, pipe) mesh: the
+model's homogeneous layer run (e.g. the TransformerBlock depth) is
+stage-stacked on a leading axis sharded over "pipe", and a single
+`lax.scan` over microbatch slots ticks activations through the stage ring
+— the stacked buffer shift lowers to XLA `collective-permute`s that ride
+ONLY the pipe axis (the GSPMD pipelining formulation; the IR lint budgets
+verify no permute leaks onto `data`/`model`). The scan is differentiable
+end-to-end, so `jax.value_and_grad` derives the backward pipeline as the
+transposed reverse scan (reverse collective-permutes) inside the SAME
+compiled program: warmup / steady interleaved forward-backward / cooldown
+with bubble fraction (S-1)/(M+S-1) — the non-interleaved 1F1B number —
+at ONE XLA dispatch per optimizer step instead of the host-driven
+O(stages·microbatches) storm below. Stage activation residuals are
+rematerialized per tick (`jax.checkpoint` on the stage body), bounding
+what the backward holds live. Composed into `ParallelTrainer` as
+`strategy="pp"` (pure pipe) and `"zero1_tp_pp"` (ZeRO-1 moments over
+`data` × Megatron TP over `model` × 1F1B over `pipe`).
+
+**Host-driven GPipe (legacy / bench baseline).** `PipelinedNetworkTrainer`
+/ `PipelinedGraphTrainer` run the GPipe two-phase schedule host-side with
+per-stage jits — dozens of dispatches per step. Kept as the paired
+baseline arm for `scaling_bench --mode pipeline` and for models whose
+heterogeneous stages the SPMD formulation cannot stack.
 
 Restriction (standard for SPMD pipelining): pipelined stages must share one
 program = identical layer structure and [.., F] -> [.., F] activation shape.
 Heterogeneous head/tail layers (embedding, classifier) run replicated outside
-the pipe region — compose with `PipelinedMLP` below.
+the pipe region.
 """
 from __future__ import annotations
 
@@ -26,7 +48,624 @@ from ..datasets.iterators import DataSet
 from ..telemetry.compile_watch import watch_compiles
 
 __all__ = ["pipeline_forward", "PipelinedDenseStack",
-           "PipelinedNetworkTrainer", "PipelinedGraphTrainer"]
+           "PipelinedNetworkTrainer", "PipelinedGraphTrainer",
+           "PipelinePlan", "make_pp_step", "make_pp_accum_superstep"]
+
+
+# ===========================================================================
+# Mesh-native 1F1B pipeline (ISSUE 15)
+# ===========================================================================
+
+def _conf_eq(a, b) -> bool:
+    """Layer-conf equality for stage homogeneity. Dataclass `==` compares
+    every field, but updater objects are plain classes whose default
+    equality is identity — two identically-built Adam(1e-3) instances
+    must still count as the same stage program."""
+    import dataclasses
+
+    if type(a) is not type(b):
+        return False
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if f.name == "updater":
+            if va is None and vb is None:
+                continue
+            if va is None or vb is None or type(va) is not type(vb) \
+                    or vars(va) != vars(vb):
+                return False
+            continue
+        if va != vb:
+            return False
+    return True
+
+
+def _tree_sig(tree):
+    """(structure, shapes, dtypes) signature of a pytree — two layers are
+    stackable iff their param/state signatures match exactly."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, tuple((np.shape(l), np.dtype(jnp.result_type(l)))
+                           for l in leaves))
+
+
+class PipelinePlan:
+    """Static stage partition of a `MultiLayerNetwork` for the mesh-native
+    1F1B step.
+
+    Finds the longest contiguous run of IDENTICAL layers (same conf, same
+    param/state signature — the `TransformerBlock` depth of an LM, the
+    hidden run of a uniform MLP), splits it into `S = mesh.shape[pipe]`
+    stages of `v` layers each, and provides the stack/unstack maps between
+    the model's per-layer tuples and the pipeline ("pp") form:
+
+        {"head": (per-layer trees before the run),
+         "stack": (v slot trees, each leaf [S, ...] — slot r of stage s is
+                   model layer lo + s*v + r),
+         "tail": (per-layer trees from the run's end, incl. the loss head)}
+
+    Head/tail run replicated over `pipe` (every pipe group member computes
+    them redundantly — they are tiny next to the stage run); only the
+    stacked region is pipe-sharded, and only its activation handoffs cross
+    pipe boundaries.
+    """
+
+    def __init__(self, model, mesh: Mesh, pipe_axis: str = "pipe",
+                 model_axis: str = "model", data_axis: str = "data",
+                 tp: bool = False):
+        from ..nn.graph import ComputationGraph
+        from ..nn.layers.feedforward import BaseOutputLayerConf
+
+        if isinstance(model, ComputationGraph):
+            raise ValueError(
+                "the mesh-native pipeline strategies stack a MultiLayer"
+                "Network's homogeneous layer run; ComputationGraph models "
+                "are not supported — use strategy='pipeline' (host-driven "
+                "GPipe) or a chain model")
+        if model.params is None:
+            model.init()
+        if model._compute_dtype is not None:
+            raise ValueError(
+                "the 1F1B step does not support compute_dtype mixed "
+                "precision yet — drop compute_dtype or use "
+                "strategy='pipeline'")
+        layers = model.layers
+        n = len(layers)
+        if n < 2 or not isinstance(layers[-1], BaseOutputLayerConf):
+            raise ValueError("last layer must be an output/loss layer")
+        for i, layer in enumerate(layers):
+            if getattr(layer, "is_recurrent", False):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) is recurrent — "
+                    "the 1F1B step supports feed-forward models only")
+            if hasattr(layer, "aux_score"):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries an "
+                    "auxiliary loss (aux_score) the pipelined loss does "
+                    "not propagate; use a SYNC strategy for MoE models")
+        self.model = model
+        self.mesh = mesh
+        self.pipe_axis = pipe_axis
+        self.model_axis = model_axis
+        self.data_axis = data_axis
+        self.tp = bool(tp)
+        S = int(mesh.shape[pipe_axis])
+        if S < 2:
+            raise ValueError(
+                f"pipeline needs a pipe axis of size >= 2, got {S} — "
+                "build the mesh with mesh_shape=(d, m, p)")
+        self.n_stages = S
+
+        # longest homogeneous run among the non-output layers
+        sigs = [(layers[i], _tree_sig(model.params[i]),
+                 _tree_sig(model.state[i])) for i in range(n - 1)]
+        best = (0, 0)   # (length, lo)
+        i = 0
+        while i < n - 1:
+            j = i + 1
+            while j < n - 1 and _conf_eq(sigs[j][0], sigs[i][0]) \
+                    and sigs[j][1] == sigs[i][1] and sigs[j][2] == sigs[i][2]:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        L, lo = best
+        if L < S:
+            raise ValueError(
+                f"model has no homogeneous layer run of >= {S} identical "
+                f"layers to stage over the pipe axis (longest run: {L}). "
+                "Pipeline the repeated block depth (e.g. TransformerBlock "
+                "x depth) or shrink the pipe axis")
+        if L % S:
+            raise ValueError(
+                f"homogeneous run of {L} layers does not divide into "
+                f"{S} pipeline stages — use a depth divisible by the "
+                f"pipe-axis size (e.g. {(L // S) * S} or {(L // S + 1) * S} "
+                "layers)")
+        self.lo, self.hi = lo, lo + L
+        self.slots = L // S
+        for i in range(self.lo + 1, self.hi):
+            if i in model.conf.preprocessors:
+                raise ValueError(
+                    f"preprocessor at layer {i} sits inside the pipelined "
+                    "stage run [" f"{self.lo}, {self.hi}) — stages must "
+                    "share one program; move it outside the homogeneous "
+                    "run or use strategy='pipeline'")
+
+    # -- stack/unstack between per-layer tuples and pp form ---------------
+    def stack(self, per_layer):
+        """Per-layer sequence (params, state or updater state) -> pp form
+        (pure jnp — usable at placement time and inside jit)."""
+        lo, hi, S, v = self.lo, self.hi, self.n_stages, self.slots
+        head = tuple(per_layer[:lo])
+        tail = tuple(per_layer[hi:])
+        stack = tuple(
+            jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[per_layer[lo + s * v + r] for s in range(S)])
+            if jax.tree_util.tree_leaves(per_layer[lo + r])
+            else per_layer[lo + r]
+            for r in range(v))
+        return {"head": head, "stack": stack, "tail": tail}
+
+    def unstack(self, pp):
+        """pp form -> per-layer tuple congruent with model.layers."""
+        lo, hi, S, v = self.lo, self.hi, self.n_stages, self.slots
+        mid = [None] * (S * v)
+        for r, slot in enumerate(pp["stack"]):
+            for s in range(S):
+                mid[s * v + r] = jax.tree_util.tree_map(
+                    lambda a, _s=s: a[_s], slot) \
+                    if jax.tree_util.tree_leaves(slot) else slot
+        return tuple(pp["head"]) + tuple(mid) + tuple(pp["tail"])
+
+    def unstack_host(self, pp):
+        """Host-side unstack (device_get first): the publish/_sync_back
+        path — re-assembling a per-layer view must not run S gather
+        programs against the live sharded buffers."""
+        host = jax.tree_util.tree_map(lambda a: np.asarray(a), pp)
+        per_layer = PipelinePlan.unstack(self, host)
+        return tuple(jax.tree_util.tree_map(jnp.asarray, t)
+                     for t in per_layer)
+
+    # -- shardings --------------------------------------------------------
+    def _tp_entries(self, layer, key, shape):
+        from .sharding import _tp_spec_for
+
+        if not self.tp or self.model_axis not in self.mesh.axis_names \
+                or int(self.mesh.shape[self.model_axis]) < 2:
+            return ()
+        return tuple(_tp_spec_for(key, shape, self.model_axis, self.mesh,
+                                  layer=layer))
+
+    def param_specs(self):
+        """pp-form PartitionSpec tree: stacked leaves P(pipe, *tp...),
+        head/tail leaves the plain TP spec (or replicated)."""
+        m = self.model
+        if self.tp:
+            size = int(dict(self.mesh.shape).get(self.model_axis, 1))
+            for layer in m.layers:
+                validate = getattr(layer, "tp_validate", None)
+                if validate is not None:
+                    validate(size)
+
+        def leaf_specs(layer, tree, stacked):
+            def spec(path, leaf):
+                key = str(path[-1].key) if path and hasattr(path[-1], "key") \
+                    else ""
+                shape = np.shape(leaf)
+                if stacked:
+                    entries = self._tp_entries(layer, key, shape[1:])
+                    return P(self.pipe_axis, *entries)
+                return P(*self._tp_entries(layer, key, shape)) \
+                    if self.tp else P()
+            return jax.tree_util.tree_map_with_path(spec, tree)
+
+        head = tuple(leaf_specs(m.layers[i], m.params[i], False)
+                     for i in range(self.lo))
+        tail = tuple(leaf_specs(m.layers[i], m.params[i], False)
+                     for i in range(self.hi, len(m.layers)))
+        params_pp = self.stack(m.params)
+        stack = tuple(leaf_specs(m.layers[self.lo + r],
+                                 params_pp["stack"][r], True)
+                      for r in range(self.slots))
+        return {"head": head, "stack": stack, "tail": tail}
+
+    def state_specs(self):
+        """pp-form specs for layer state: stacked leaves P(pipe),
+        everything else replicated."""
+        m = self.model
+        rep = lambda t: jax.tree_util.tree_map(lambda a: P(), t)
+        state_pp = self.stack(m.state)
+        return {"head": tuple(rep(s) for s in state_pp["head"]),
+                "stack": tuple(jax.tree_util.tree_map(
+                    lambda a: P(self.pipe_axis), s)
+                    for s in state_pp["stack"]),
+                "tail": tuple(rep(s) for s in state_pp["tail"])}
+
+    def shardings(self, specs):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    # -- regularization / update halves ----------------------------------
+    def reg_score(self, params_pp):
+        """Full-network l1/l2 penalty on pp-form params. Per-layer
+        penalties are elementwise sums, so a stacked slot's penalty over
+        its [S, ...] leaves equals the sum of the S per-layer penalties
+        (identical confs by construction)."""
+        m = self.model
+        total = jnp.float32(0.0)
+        for i in range(self.lo):
+            p = params_pp["head"][i]
+            if p:
+                total = total + m.layers[i].reg_score(p)
+        for r in range(self.slots):
+            p = params_pp["stack"][r]
+            if p:
+                total = total + m.layers[self.lo + r].reg_score(p)
+        for k, i in enumerate(range(self.hi, len(m.layers))):
+            p = params_pp["tail"][k]
+            if p:
+                total = total + m.layers[i].reg_score(p)
+        return total
+
+    def apply_updates(self, params_pp, grads_pp, opt_pp, step):
+        """The update half on pp-form trees: head/tail through the
+        model's own `apply_layer_updates`, stacked slots through the SAME
+        helper vmapped over the stage axis as a one-layer slice
+        (elementwise updaters + per-tensor gradient normalization are
+        exactly per-layer under vmap; stage confs are identical by
+        construction — one source of truth for the update math)."""
+        m = self.model
+        head_p, head_o = m.apply_layer_updates(
+            m.layers[:self.lo], list(params_pp["head"]),
+            list(grads_pp["head"]), list(opt_pp["head"]), step)
+        tail_p, tail_o = m.apply_layer_updates(
+            m.layers[self.hi:], list(params_pp["tail"]),
+            list(grads_pp["tail"]), list(opt_pp["tail"]), step)
+        stack_p, stack_o = [], []
+        for r in range(self.slots):
+            conf = m.layers[self.lo + r]
+            p, g, o = (params_pp["stack"][r], grads_pp["stack"][r],
+                       opt_pp["stack"][r])
+            if not p or conf.frozen:
+                stack_p.append(p)
+                stack_o.append(o)
+                continue
+
+            def one(p1, g1, o1, _conf=conf):
+                np1, no1 = m.apply_layer_updates(
+                    [_conf], [p1], [g1], [o1], step)
+                return np1[0], no1[0]
+
+            np_, no_ = jax.vmap(one)(p, g, o)
+            stack_p.append(np_)
+            stack_o.append(no_)
+        return ({"head": tuple(head_p), "stack": tuple(stack_p),
+                 "tail": tuple(tail_p)},
+                {"head": tuple(head_o), "stack": tuple(stack_o),
+                 "tail": tuple(tail_o)})
+
+
+#: with_sharding_constraint sites the 1F1B builder emits into one forward
+#: trace (inject buffer, post-inject buf, post-stage y, post-roll buf, out
+#: buffer) — the declared schedule half of the IR contract. The traced
+#: program carries AT LEAST this many `sharding_constraint` eqns (the AD
+#: transpose re-emits the in-loss sites); a count below it means a stage
+#: constraint was dropped and GSPMD propagation is free to replicate the
+#: pipe-sharded buffers.
+PP_CONSTRAINT_SITES = 5
+
+
+def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
+    """Build the pipelined M-microbatch loss:
+
+        loss_fn(params_pp, state_pp, keys[M, 2], xs[M, mb, ...],
+                ys[M, mb, ...], lms or None)
+            -> (mean_score, (new_state_pp, micro_scores[M]))
+
+    Per-microbatch math mirrors `MultiLayerNetwork._loss_fn` exactly —
+    the same `jax.random.split` chain (micro key -> (forward, out_rng) ->
+    per-layer keys), the same preprocessor application points, the same
+    masked-mean loss + live-row-normalized regularization — so an M-step
+    is equivalent to `fit(grad_accumulation=M)` on the identical
+    microbatches at f32-ulp (the pipeline reassociates matmuls into the
+    stage-batched form; nothing else differs).
+
+    `mutate` (IR-probe seeding only — never a training path):
+      "drop_stage_constraint"  emit NO buffer sharding constraints
+      "permute_data_axis"      additionally roll the INJECTION buffer
+                               along its data-sharded row axis (a halo
+                               exchange before the ring scan) — a
+                               collective-permute leaking onto `data`
+    """
+    m = plan.model
+    layers = m.layers
+    n = len(layers)
+    lo, hi, S, v = plan.lo, plan.hi, plan.n_stages, plan.slots
+    preproc = m.conf.preprocessors
+    mesh = plan.mesh
+    pipe, data = plan.pipe_axis, plan.data_axis
+    drop_constraints = mutate == "drop_stage_constraint"
+    permute_data = mutate == "permute_data_axis"
+    if mutate not in (None, "drop_stage_constraint", "permute_data_axis"):
+        raise ValueError(f"unknown mutation {mutate!r}")
+
+    def constrain(x, spec):
+        if drop_constraints:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+
+    def micro_keys(k):
+        # the _loss_fn chain: k -> (forward rng, out_rng); forward rng ->
+        # one key per non-output layer (upto = n - 1)
+        rng_f, out_rng = jax.random.split(k)
+        lk = jax.random.split(rng_f, max(1, n - 1))
+        return lk, out_rng
+
+    def head_apply(params_head, state_head, x, lk):
+        new_state = list(state_head)
+        for i in range(lo):
+            if i in preproc:
+                x = preproc[i].apply(x)
+            x, new_state[i] = layers[i].apply(
+                params_head[i], state_head[i], x, train=True, rng=lk[i],
+                mask=None)
+        if lo in preproc:
+            x = preproc[lo].apply(x)
+        return x, tuple(new_state)
+
+    def stage_apply(slot_params, slot_states, x, keys):
+        # ONE stage's v layers; vmapped over the stage axis by the caller
+        # (confs are identical across stages by PipelinePlan construction)
+        new_states = []
+        for r in range(v):
+            x, s_r = layers[lo + r].apply(
+                slot_params[r], slot_states[r], x, train=True,
+                rng=keys[r], mask=None)
+            new_states.append(s_r)
+        return x, tuple(new_states)
+
+    def tail_loss(params_tail, state_tail, h, y, lk, out_rng, lm):
+        new_state = list(state_tail)
+        for k, i in enumerate(range(hi, n - 1)):
+            if i in preproc:
+                h = preproc[i].apply(h)
+            h, new_state[k] = layers[i].apply(
+                params_tail[k], state_tail[k], h, train=True, rng=lk[i],
+                mask=None)
+        if (n - 1) in preproc:
+            h = preproc[n - 1].apply(h)
+        loss = layers[-1].loss_score(params_tail[-1], state_tail[-1], h, y,
+                                     train=True, rng=out_rng, mask=lm)
+        return loss, tuple(new_state)
+
+    def loss_fn(params_pp, state_pp, keys, xs, ys, lms):
+        f32 = jnp.float32
+        M = xs.shape[0]
+        T = M + S - 1
+        lk_all, out_all = jax.vmap(micro_keys)(keys)   # [M, n-1, 2], [M, 2]
+        pipe_keys = lk_all[:, lo:hi].reshape(M, S, v, 2)
+        reg = plan.reg_score(params_pp)
+
+        # one-hot [M] selectors replace every TRACED-index read/write on
+        # the microbatch-slot buffers inside the ring scan: a
+        # dynamic-update-slice on a mesh-sharded buffer inside a
+        # differentiated while loop trips XLA's partitioned-DUS index
+        # typing under x64 (s64 loop index vs s32 partition offset — the
+        # same verifier bug the accum supersteps dodge with carried int32
+        # buffers), while the one-hot contraction partitions cleanly and
+        # its AD transpose is another contraction. Values are
+        # bit-identical: one slot carries 1.0, the rest contribute exact
+        # zeros.
+        slot_iota = jnp.arange(M, dtype=jnp.int32)
+
+        def onehot(i):
+            return (slot_iota == i).astype(f32)
+
+        def read_slot(buf_m, i):
+            oh = onehot(i).reshape((M,) + (1,) * (buf_m.ndim - 1))
+            return jnp.sum(buf_m * oh, axis=0)
+
+        def write_slot(buf_m, val, i):
+            oh = onehot(i).reshape((M,) + (1,) * (buf_m.ndim - 1))
+            return buf_m + oh * val[None]
+
+        # -- 1) head: microbatches in order (state threads), the M
+        #       iterations UNROLLED (M is static and small — the
+        #       microbatch count). A lax.scan here would stack the
+        #       differentiated body's sharded residuals with the same
+        #       mis-typed partitioned DUS the one-hot forms avoid; the
+        #       unrolled loop has no residual stacking at all.
+        if lo:
+            hstate = state_pp["head"]
+            hs = []
+            for i in range(M):
+                h, hstate = head_apply(params_pp["head"], hstate, xs[i],
+                                       lk_all[i])
+                hs.append(h)
+            head_state = hstate
+            inj = jnp.stack(hs)
+        else:
+            head_state, inj = state_pp["head"], xs
+        inj = constrain(inj, P(None, data))
+        if permute_data:
+            # IR-probe mutation: a halo exchange riding the DATA axis —
+            # exactly the leak the per-axis byte budgets exist to catch
+            # (math is irrelevant; probes only compile)
+            inj = jnp.roll(inj, 1, axis=1)
+            inj = constrain(inj, P(None, data))
+
+        # -- 2) the pipeline ring: one scan over M+S-1 ticks. buf[s] is
+        #       the activation ENTERING stage s this tick; the stacked
+        #       stage axis is pipe-sharded, so the end-of-tick shift
+        #       lowers to a collective-permute on `pipe` only.
+        vstage = jax.checkpoint(jax.vmap(stage_apply))
+        buf0 = jnp.zeros((S,) + inj.shape[1:], inj.dtype)
+        out0 = jnp.zeros_like(inj)
+        stage_ids = jnp.arange(S, dtype=jnp.int32)
+
+        def tick(carry, t):
+            buf, sstack, out = carry
+            inject = jnp.where(t < M,
+                               read_slot(inj, jnp.clip(t, 0, M - 1)),
+                               jnp.zeros_like(buf[0]))
+            buf = buf.at[0].set(inject)
+            buf = constrain(buf, P(pipe, data))
+            mi = t - stage_ids
+            valid = (mi >= 0) & (mi < M)
+            midx = jnp.clip(mi, 0, M - 1)
+            keys_t = pipe_keys[midx, stage_ids]        # [S, v, 2]
+            y, new_sstack = vstage(params_pp["stack"], sstack, buf, keys_t)
+            y = constrain(y, P(pipe, data))
+            # warmup/cooldown slots carry garbage — their state updates
+            # must not land (their activations never reach the loss, so
+            # AD already gives them zero cotangents)
+            new_sstack = jax.tree_util.tree_map(
+                lambda nw, od: jnp.where(
+                    valid.reshape((S,) + (1,) * (nw.ndim - 1)), nw, od),
+                new_sstack, sstack)
+            oi = t - (S - 1)
+            fin = jnp.where(oi >= 0, y[S - 1], jnp.zeros_like(y[S - 1]))
+            out = write_slot(out, fin, jnp.clip(oi, 0, M - 1))
+            out = constrain(out, P(None, data))
+            buf = jnp.roll(y, 1, axis=0)
+            buf = constrain(buf, P(pipe, data))
+            return (buf, new_sstack, out), None
+
+        (_, stack_state, out), _ = jax.lax.scan(
+            tick, (buf0, state_pp["stack"], out0),
+            jnp.arange(T, dtype=jnp.int32))
+
+        # -- 3) tail + loss: microbatches in order (state threads),
+        #       UNROLLED like the head (static integer indexing into the
+        #       finished-output buffer; a differentiated lax.scan would
+        #       stack its sharded residuals/cotangents with the
+        #       mis-typed partitioned DUS).
+        tstate = state_pp["tail"]
+        mscore_list = []
+        for i in range(M):
+            h = out[i]
+            lm = None if lms is None else lms[i]
+            score, tstate = tail_loss(params_pp["tail"], tstate, h, ys[i],
+                                      lk_all[i], out_all[i], lm)
+            batch = h.shape[0]
+            if lm is not None:
+                live = lm.astype(f32).reshape((lm.shape[0], -1)).max(axis=1)
+                batch = jnp.maximum(jnp.sum(live), 1.0)
+            mscore_list.append((score + reg / batch).astype(f32))
+        tail_state = tstate
+        mscores = jnp.stack(mscore_list)
+        new_state = {"head": head_state, "stack": stack_state,
+                     "tail": tail_state}
+        return jnp.mean(mscores), (new_state, mscores)
+
+    return loss_fn
+
+
+def _pp_opt_step(plan: PipelinePlan, zero_plan=None,
+                 mutate: Optional[str] = None):
+    """One optimizer step on pp-form trees: pipelined forward/backward,
+    mean gradient over the M microbatches, update (vmapped over stages),
+    ZeRO-1 shard constraints when composed. Shared by the per-batch step
+    and the accumulated superstep."""
+    loss_fn = _pp_loss_fn(plan, mutate=mutate)
+    minimize = plan.model.conf.conf.minimize
+
+    def opt_step(params, state, opt, step, keys, xs, ys, lms):
+        (score, (new_state, mscores)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, state, keys, xs, ys, lms)
+        if not minimize:
+            grads = jax.tree_util.tree_map(lambda g: -g, grads)
+        new_params, new_opt = plan.apply_updates(params, grads, opt, step)
+        if zero_plan is not None:
+            new_params = zero_plan.constrain_params(new_params)
+            new_opt = zero_plan.constrain_opt(new_opt)
+        return new_params, new_state, new_opt, score, mscores
+
+    return opt_step
+
+
+def _pp_info(plan: PipelinePlan, zero_plan=None):
+    info = {"pp_constraints": PP_CONSTRAINT_SITES,
+            "n_stages": plan.n_stages, "slots": plan.slots,
+            "stage_run": (plan.lo, plan.hi),
+            "expected_constraints": PP_CONSTRAINT_SITES}
+    if zero_plan is not None:
+        info["zero"] = dict(zero_plan.info)
+        info["expected_constraints"] += zero_plan.expected_constraints()
+    return info
+
+
+def _check_pp_masks(fm):
+    if fm is not None and jax.tree_util.tree_leaves(fm):
+        raise ValueError(
+            "the 1F1B step threads the weight-zero LABEL mask through "
+            "the last-stage loss, but features masks (time_buckets "
+            "padding) are not supported — drop time_buckets or use a "
+            "SYNC strategy")
+
+
+def make_pp_step(model, plan: PipelinePlan, *, zero_plan=None,
+                 mutate: Optional[str] = None):
+    """The per-batch 1F1B train step (M = 1): signature-compatible with
+    `model.train_step_fn` on pp-form trees — (params, state, opt, step,
+    x, y, rng, fmask, lmask) -> (params, state, opt, score) — so
+    `ParallelTrainer` jits it with the pipeline shardings and
+    `build_superstep` scans it unchanged. `rng` is the microbatch key
+    (the caller's per-batch split), exactly as on every other strategy.
+    Returns (step_fn, info)."""
+    opt_step = _pp_opt_step(plan, zero_plan=zero_plan, mutate=mutate)
+
+    def step(params, state, opt_state, step_i, x, y, rng, fmask, lmask):
+        _check_pp_masks(fmask)
+        lms = None if lmask is None or not jax.tree_util.tree_leaves(lmask) \
+            else lmask[None]
+        params, state, opt_state, score, _ = opt_step(
+            params, state, opt_state, step_i, rng[None], x[None], y[None],
+            lms)
+        return params, state, opt_state, score
+
+    return step, _pp_info(plan, zero_plan)
+
+
+def make_pp_accum_superstep(model, plan: PipelinePlan, *, zero_plan=None,
+                            mutate: Optional[str] = None):
+    """The ACCUMULATED 1F1B superstep: the pipeline's microbatches ARE
+    the accumulation microbatches (ISSUE 15 unifying ISSUE 12's
+    machinery) — a [K, M, batch, ...] window runs K optimizer steps, each
+    ONE M-microbatch 1F1B schedule, in a single dispatch. Signature
+    matches `nn/superstep.build_accum_superstep`: (params, state, opt,
+    step0, rng0, xs, ys, fm, lm) -> (params, state, opt, rng, scores[K],
+    micro_scores[K, M]); the RNG chain advances per MICROBATCH with the
+    identical split sequence, so the step is equivalent to
+    `fit(grad_accumulation=M)` at f32-ulp. Returns (fn, info)."""
+    opt_step = _pp_opt_step(plan, zero_plan=zero_plan, mutate=mutate)
+
+    def superstep(params, state, opt_state, step0, rng0, xs, ys, fm, lm):
+        _check_pp_masks(fm)
+
+        def body(carry, inp):
+            params, state, opt, step, rng = carry
+            x, y, l = inp
+            M = x.shape[0]
+
+            def draw(r, _):
+                r, k = jax.random.split(r)
+                return r, k
+
+            rng, keys = jax.lax.scan(draw, rng, None, length=M)
+            params, state, opt, score, mscores = opt_step(
+                params, state, opt, step, keys, x, y, l)
+            return (params, state, opt, step + 1, rng), (score, mscores)
+
+        lms = None if lm is None or not jax.tree_util.tree_leaves(lm) \
+            else lm
+        (params, state, opt, _step, rng), (scores, mscores) = jax.lax.scan(
+            body, (params, state, opt_state, step0, rng0), (xs, ys, lms))
+        return params, state, opt, rng, scores, mscores
+
+    return superstep, _pp_info(plan, zero_plan)
 
 
 def pipeline_forward(stage_fn: Callable, stacked_params, x_microbatches,
@@ -131,6 +770,16 @@ class PipelinedDenseStack:
         out = watch_compiles(jax.jit(wrapper),
                              "pipeline/spmd_forward")(params, xm)
         return out.reshape(B, self.features)
+
+
+def _jit_stage(fn, name: str):
+    """Build ONE stage's jitted callable. Per-stage jits are constructed
+    once per trainer at cached-property build time and reused for the
+    trainer's lifetime — hoisting the `jax.jit` construction here (out of
+    the per-stage build loops) keeps that contract visible to graftlint's
+    `jit-in-loop` rule without pragmas: each call site builds exactly one
+    jit with a persistent cache."""
+    return watch_compiles(jax.jit(fn), name)
 
 
 class PipelinedNetworkTrainer:
@@ -298,9 +947,8 @@ class PipelinedNetworkTrainer:
                 gp, gx = vjp((cot, jax.tree_util.tree_map(jnp.zeros_like,
                                                           new_state)))
                 return gp, gx, new_state
-            # one jit per stage, built once
-            jits.append(watch_compiles(jax.jit(bwd),  # graftlint: disable=jit-in-loop
-                                       "pipeline/stage_bwd"))
+            # one jit per stage, built once (via _jit_stage)
+            jits.append(_jit_stage(bwd, "pipeline/stage_bwd"))
         return jits
 
     @functools.cached_property
@@ -315,19 +963,19 @@ class PipelinedNetworkTrainer:
         out_layer = m.layers[hi - 1]
         out_k = hi - 1 - lo
 
-        def loss_fn(params, state, x, y, rng):
+        def loss_fn(params, state, x, y, rng, lm):
             rng_f, out_rng = jax.random.split(rng)
             h, new_state = fwd(params, state, x, rng_f)
             i = hi - 1
             if i in m.conf.preprocessors:
                 h = m.conf.preprocessors[i].apply(h)
             loss = out_layer.loss_score(params[out_k], state[out_k], h, y,
-                                        train=True, rng=out_rng, mask=None)
+                                        train=True, rng=out_rng, mask=lm)
             return loss, new_state
 
-        def grad_fn(params, state, x, y, rng):
+        def grad_fn(params, state, x, y, rng, lm=None):
             (loss, new_state), vjp = jax.vjp(
-                lambda p, xi: loss_fn(p, state, xi, y, rng), params, x)
+                lambda p, xi: loss_fn(p, state, xi, y, rng, lm), params, x)
             gp, gx = vjp((jnp.float32(1.0),
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state
@@ -349,9 +997,8 @@ class PipelinedNetworkTrainer:
                     if p:
                         total = total + layer.reg_score(p)
                 return total
-            jits.append(watch_compiles(
-                jax.jit(jax.value_and_grad(reg)),  # graftlint: disable=jit-in-loop
-                "pipeline/stage_reg"))
+            jits.append(_jit_stage(jax.value_and_grad(reg),
+                                   "pipeline/stage_reg"))
         return jits
 
     @functools.cached_property
@@ -369,8 +1016,7 @@ class PipelinedNetworkTrainer:
                 p, o = self.model.apply_layer_updates(
                     _layers, params, grads, opt, step)
                 return tuple(p), tuple(o)
-            jits.append(watch_compiles(
-                jax.jit(upd), "pipeline/stage_update"))  # per-stage, cached  # graftlint: disable=jit-in-loop
+            jits.append(_jit_stage(upd, "pipeline/stage_update"))
         return jits
 
     # -- training --------------------------------------------------------
@@ -385,16 +1031,31 @@ class PipelinedNetworkTrainer:
         return self
 
     def _fit_batch(self, ds: DataSet):
-        if ds.features_mask is not None or ds.labels_mask is not None:
-            raise ValueError("pipeline trainer does not support masks")
+        if ds.features_mask is not None:
+            raise ValueError(
+                "pipeline trainer does not support features masks "
+                "(time_buckets padding); the weight-zero LABELS mask "
+                "(pad_ragged) threads through the last-stage loss")
         x = np.asarray(ds.features)
         y = np.asarray(ds.labels)
+        lmask = (None if ds.labels_mask is None
+                 else np.asarray(ds.labels_mask))
         B = x.shape[0]
         M = self.n_microbatches
         if B % M != 0:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
         xs = np.split(x, M)
         ys = np.split(y, M)
+        # per-microbatch label-mask slices (ISSUE 15 satellite: pad_ragged
+        # composes — padded rows are weight-zero in the last-stage loss);
+        # B_live normalizes the regularization term by REAL rows, exactly
+        # as the masked single-device _loss_fn does
+        lms = [None] * M if lmask is None else np.split(lmask, M)
+        if lmask is None:
+            B_live = float(B)
+        else:
+            live = lmask.astype(np.float32).reshape(B, -1).max(axis=1)
+            B_live = max(1.0, float(live.sum()))
         S = self.n_stages
         step = jnp.asarray(self.iteration_count, jnp.int32)
         # per-(step, microbatch, stage) PRNG: dropout-carrying models get
@@ -422,9 +1083,11 @@ class PipelinedNetworkTrainer:
         new_states = list(self.stage_state)
         for mi in range(M):
             yb = jax.device_put(jnp.asarray(ys[mi]), self.devices[S - 1])
+            lb = (None if lms[mi] is None else
+                  jax.device_put(jnp.asarray(lms[mi]), self.devices[S - 1]))
             loss, gp, cot, st = self._last_stage_grad(
                 self.stage_params[S - 1], self.stage_state[S - 1],
-                acts[mi][S - 1], yb, skey(mi, S - 1))
+                acts[mi][S - 1], yb, skey(mi, S - 1), lb)
             losses.append(loss)
             new_states[S - 1] = st
             grad_acc[S - 1] = gp if grad_acc[S - 1] is None else \
@@ -443,14 +1106,15 @@ class PipelinedNetworkTrainer:
         for s in range(S):
             g = jax.tree_util.tree_map(lambda a: a / M, grad_acc[s])
             reg_v, reg_g = self._stage_reg_grads[s](self.stage_params[s])
-            g = jax.tree_util.tree_map(lambda a, b: a + b / B, g, reg_g)
+            g = jax.tree_util.tree_map(lambda a, b: a + b / B_live, g,
+                                       reg_g)
             reg_total = reg_total + jax.device_get(reg_v)
             self.stage_params[s], self.stage_opt[s] = \
                 self._stage_update_jits[s](self.stage_params[s], g,
                                            self.stage_opt[s], step)
         self.stage_state = new_states
         self._score = float(np.mean([jax.device_get(l) for l in losses])
-                            + reg_total / B)
+                            + reg_total / B_live)
         self.iteration_count += 1
 
     def score(self) -> float:
@@ -665,7 +1329,7 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
         out_layer = conf.vertices[out_name]
         fwd = self._stage_forward(s)
 
-        def loss_fn(params, state, x, y, rng):
+        def loss_fn(params, state, x, y, rng, lm):
             rng_f, out_rng = jax.random.split(rng)
             h, new_state = fwd(params, state, x, rng_f)
             rec = conf.inferred_input_types.get(out_name)
@@ -673,12 +1337,12 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                 h = rec[0].apply(h)
             loss = out_layer.loss_score(params[out_name], state[out_name],
                                         h, y, train=True, rng=out_rng,
-                                        mask=None)
+                                        mask=lm)
             return loss, new_state
 
-        def grad_fn(params, state, x, y, rng):
+        def grad_fn(params, state, x, y, rng, lm=None):
             (loss, new_state), vjp = jax.vjp(
-                lambda p, xi: loss_fn(p, state, xi, y, rng), params, x)
+                lambda p, xi: loss_fn(p, state, xi, y, rng, lm), params, x)
             gp, gx = vjp((jnp.float32(1.0),
                           jax.tree_util.tree_map(jnp.zeros_like, new_state)))
             return loss, gp, gx, new_state
@@ -700,9 +1364,8 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     if p:
                         total = total + conf.vertices[n].reg_score(p)
                 return total
-            jits.append(watch_compiles(
-                jax.jit(jax.value_and_grad(reg)),  # graftlint: disable=jit-in-loop
-                "pipeline/graph_stage_reg"))
+            jits.append(_jit_stage(jax.value_and_grad(reg),
+                                   "pipeline/graph_stage_reg"))
         return jits
 
     @functools.cached_property
@@ -750,8 +1413,7 @@ class PipelinedGraphTrainer(PipelinedNetworkTrainer):
                     new_p[n] = jax.tree_util.tree_map(
                         lambda a, u_: a - u_, p, updates)
                 return new_p, new_o
-            jits.append(watch_compiles(
-                jax.jit(upd), "pipeline/graph_stage_update"))  # per-stage, cached  # graftlint: disable=jit-in-loop
+            jits.append(_jit_stage(upd, "pipeline/graph_stage_update"))
         return jits
 
     def sync_back(self):

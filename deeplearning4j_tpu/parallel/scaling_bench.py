@@ -492,22 +492,21 @@ def measure_pipeline(s_stages: int = 4, microbatches=(1, 2, 4, 8),
       track M/(M+S-1). Reported: per-tick time (theory: constant over M)
       and measured efficiency normalized at the largest M against its
       own theory point.
-    * `network` / `graph`: the REAL model trainers
-      (PipelinedNetworkTrainer / PipelinedGraphTrainer) at fixed global
-      batch across M. Their GPipe schedule is driven host-side, so on a
-      virtual mesh all stage work serializes — no device bubble is
-      observable; what IS measurable (and reported) is the per-dispatch
-      overhead growing with M*S, i.e. the cost curve a user pays for
-      smaller bubbles on real hardware.
+    * `f1b` (ISSUE 15, `measure_pipeline_1f1b`): the transformer LM
+      trained mesh-native 1F1B vs host-GPipe vs ZERO1×TP in alternating
+      paired windows — tokens/s, dispatch-span share and compile counts
+      per arm, plus the 1F1B step's per-axis compiled-HLO collective
+      payloads. The mode's `gate` is the paired 1F1B-vs-host-GPipe
+      throughput ratio (> 1): on the virtual mesh both arms pay the
+      same serialized flops, so the delta IS the per-dispatch overhead
+      the single compiled schedule removes.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from ..datasets.iterators import DataSet
     from .mesh import make_mesh
-    from .pipeline import (PipelinedDenseStack, PipelinedGraphTrainer,
-                           PipelinedNetworkTrainer)
+    from .pipeline import PipelinedDenseStack
 
     mesh = make_mesh({"pipe": s_stages}, devices=jax.devices()[:s_stages])
     r = np.random.default_rng(0)
@@ -566,54 +565,175 @@ def measure_pipeline(s_stages: int = 4, microbatches=(1, 2, 4, 8),
             for m in microbatches],
     }
 
-    # -- real-model trainer families ------------------------------------
-    from ..nn.conf import InputType, NeuralNetConfiguration
-    from ..nn.graph import ComputationGraph
-    from ..nn.layers import DenseLayer, OutputLayer
-    from ..nn.multilayer import MultiLayerNetwork
-    from ..nn.updaters import Sgd
+    # -- 1F1B vs host-GPipe vs ZERO1×TP (ISSUE 15) ----------------------
+    out["f1b"] = measure_pipeline_1f1b(
+        s_stages=s_stages, steps=steps, reps=reps)
+    out["gate"] = out["f1b"]["gate"]
+    return out
 
-    def mlp_model():
-        b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.01)).list()
-        for _ in range(7):
-            b = b.layer(DenseLayer(n_out=hidden, activation="tanh"))
-        conf = (b.layer(OutputLayer(n_out=10, loss="mcxent"))
-                .set_input_type(InputType.feed_forward(hidden)).build())
-        return MultiLayerNetwork(conf).init()
 
-    def graph_model():
-        b = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.01))
-             .graph_builder())
-        b.add_inputs("in")
-        prev = "in"
-        for i in range(7):
-            b.add_layer(f"d{i}", DenseLayer(n_out=hidden,
-                                            activation="tanh"), prev)
-            prev = f"d{i}"
-        b.add_layer("out", OutputLayer(n_out=10, loss="mcxent"), prev)
-        b.set_outputs("out")
-        b.set_input_types(InputType.feed_forward(hidden))
-        return ComputationGraph(b.build()).init()
+def measure_pipeline_1f1b(s_stages: int = 4, vocab: int = 64,
+                          width: int = 64, heads: int = 4, seq: int = 32,
+                          micro_batch: int = 8, m: int = 8, steps: int = 2,
+                          warmup: int = 1, reps: int = 3):
+    """Mesh-native 1F1B vs host-GPipe vs ZERO1×TP, paired (ISSUE 15).
 
-    x = r.normal(size=(global_batch, hidden)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, global_batch)]
-    ds = DataSet(x, y)
-    for fam, builder, cls in (("network", mlp_model,
-                               PipelinedNetworkTrainer),
-                              ("graph", graph_model, PipelinedGraphTrainer)):
-        fam_out = {"step_ms": {}, "step_rep_ms": {}}
-        for m in microbatches:
-            tr = cls(builder(), mesh, n_microbatches=m)
-            tr.fit(ds)
-            rep = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                for _ in range(steps):
-                    tr.fit(ds)
-                rep.append((time.perf_counter() - t0) / steps)
-            fam_out["step_ms"][str(m)] = round(_median(rep) * 1e3, 2)
-            fam_out["step_rep_ms"][str(m)] = [round(v * 1e3, 2) for v in rep]
-        out[fam] = fam_out
+    The transformer LM (depth = `s_stages` blocks, so every arm stages
+    the identical model) trains the same effective batch
+    (micro_batch · m rows · seq tokens) per optimizer step on each arm,
+    in ALTERNATING measured windows so host-load drift contaminates all
+    arms equally:
+
+      * `pp_1f1b`      — strategy="pp" on a (1, 1, S) mesh:
+                         ONE jitted SPMD dispatch per optimizer step
+                         (`fit(grad_accumulation=m)`)
+      * `host_gpipe`   — the legacy PipelinedNetworkTrainer on the same
+                         S devices: O(S·m) per-stage dispatches per step
+      * `zero1_tp_pp`  — strategy="zero1_tp_pp" on (2, 1, S): the 3-D
+                         composition on all 8 devices
+      * `zero1_tp`     — strategy="zero1_tp" on (2, 4): the 2-D
+                         reference without a pipe axis
+
+    Reports tokens/s per arm with the PAIRED per-round
+    1F1B-vs-host-GPipe ratio (the acceptance gate: > 1 — the single
+    compiled schedule must beat the host-driven dispatch storm even on
+    the virtual mesh, where both pay the same serialized flops and the
+    delta IS the dispatch overhead), per-arm dispatch-span share and
+    compile counts from telemetry (the O(S·M) -> O(1) evidence), the
+    structural per-step dispatch counts, and the 1F1B step's per-axis
+    compiled-HLO collective payloads (permutes must ride `pipe` only)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..datasets.iterators import DataSet, ListDataSetIterator
+    from ..telemetry import runtime as telemetry_runtime
+    from .mesh import make_mesh
+    from .pipeline import PipelinedNetworkTrainer
+    from .trainer import ParallelTrainer
+
+    S = s_stages
+    lm = lambda: _build_transformer_lm(vocab, width, heads, S, seq)
+    r = np.random.default_rng(0)
+
+    def micros(n):
+        return [DataSet(
+            r.integers(0, vocab, (micro_batch, seq, 1)).astype(np.float32),
+            np.eye(vocab, dtype=np.float32)[
+                r.integers(0, vocab, (micro_batch, seq))])
+            for _ in range(n)]
+
+    batch_micros = micros(m)
+    big = DataSet(
+        np.concatenate([np.asarray(d.features) for d in batch_micros]),
+        np.concatenate([np.asarray(d.labels) for d in batch_micros]))
+    devs = jax.devices()
+    pipe_mesh = make_mesh({"pipe": S}, devices=devs[:S])
+
+    arms = {}
+    arms["pp_1f1b"] = ParallelTrainer(
+        lm(), mesh=make_mesh({"data": 1, "model": 1, "pipe": S},
+                             devices=devs[:S]), strategy="pp")
+    arms["host_gpipe"] = PipelinedNetworkTrainer(lm(), pipe_mesh,
+                                                 n_microbatches=m)
+    if len(devs) >= 2 * S:
+        arms["zero1_tp_pp"] = ParallelTrainer(
+            lm(), mesh=make_mesh({"data": 2, "model": 1, "pipe": S},
+                                 devices=devs[:2 * S]),
+            strategy="zero1_tp_pp")
+        arms["zero1_tp"] = ParallelTrainer(
+            lm(), mesh=make_mesh({"data": 2, "model": S},
+                                 devices=devs[:2 * S]),
+            strategy="zero1_tp")
+
+    def run_step_window(name, tr, n_steps):
+        """n_steps optimizer steps over the same effective batch."""
+        if name == "host_gpipe":
+            for _ in range(n_steps):
+                tr._fit_batch(big)
+            float(tr.score())
+        elif name == "zero1_tp":
+            for _ in range(n_steps):
+                tr.fit(big)
+            float(tr.score())
+        else:
+            it = ListDataSetIterator(list(batch_micros) * n_steps)
+            tr.fit(it, grad_accumulation=m)
+            float(tr.score())
+
+    sess = telemetry_runtime.active()
+    for name, tr in arms.items():
+        run_step_window(name, tr, warmup)
+
+    tokens = micro_batch * m * seq
+    rep_tps = {name: [] for name in arms}
+    spans = {name: {"dispatch_s": 0.0, "wall_s": 0.0} for name in arms}
+    for _ in range(max(1, int(reps))):
+        for name, tr in arms.items():
+            d0 = (sess.span_totals().get("device/dispatch", 0.0)
+                  if sess else 0.0)
+            t0 = time.perf_counter()
+            run_step_window(name, tr, steps)
+            wall = time.perf_counter() - t0
+            rep_tps[name].append(tokens * steps / wall)
+            if sess:
+                spans[name]["dispatch_s"] += (
+                    sess.span_totals().get("device/dispatch", 0.0) - d0)
+            spans[name]["wall_s"] += wall
+
+    out = {"model": {"vocab": vocab, "width": width, "heads": heads,
+                     "depth": S, "seq": seq, "micro_batch": micro_batch,
+                     "m": m},
+           "arms": {}}
+    for name in arms:
+        tps = sorted(rep_tps[name])
+        arm = {"tokens_per_s": round(_median(tps), 1),
+               "tokens_per_s_rep": [round(v, 1) for v in tps]}
+        if spans[name]["wall_s"]:
+            arm["dispatch_span_share"] = round(
+                spans[name]["dispatch_s"] / spans[name]["wall_s"], 3)
+        out["arms"][name] = arm
+    # structural dispatches per optimizer step: the host schedule pays a
+    # fwd + bwd jit per (stage, microbatch) plus per-stage reg/update
+    # jits; the 1F1B step is ONE dispatch
+    out["dispatches_per_step"] = {
+        "host_gpipe": 2 * S * m + 2 * S, "pp_1f1b": 1}
+    if sess:
+        out["compiles"] = {k: v["count"]
+                           for k, v in sess.compiles.report().items()
+                           if v["count"] and ("pipeline/" in k
+                                              or "pp" in k)}
+    ratios = sorted(p / h for p, h in zip(rep_tps["pp_1f1b"],
+                                          rep_tps["host_gpipe"]))
+    out["f1b_vs_host_gpipe_paired"] = round(ratios[len(ratios) // 2], 3)
+    out["f1b_vs_host_gpipe_spread"] = [round(ratios[0], 3),
+                                       round(ratios[-1], 3)]
+
+    # per-axis compiled-HLO payload of the 3-D step (permutes must ride
+    # `pipe` only; `data` carries the ZeRO/gradient traffic)
+    if "zero1_tp_pp" in arms:
+        from ..analysis.ir import measured_collective_bytes_by_axis
+        tr = arms["zero1_tp_pp"]
+        fn = tr._accum_superstep_jit(False).__wrapped__
+        xs = jnp.stack([jnp.asarray(np.asarray(d.features))
+                        for d in batch_micros])[None]
+        ys = jnp.stack([jnp.asarray(np.asarray(d.labels))
+                        for d in batch_micros])[None]
+        args = (tr._params, tr._state, tr._opt, jnp.asarray(0, jnp.int32),
+                jax.random.PRNGKey(0), xs, ys, None, None)
+        text = fn.trace(*args).lower().compile().as_text()
+        by_axis = measured_collective_bytes_by_axis(
+            text, {"data": 2, "model": 1, "pipe": S})
+        out["collective_bytes_by_axis"] = {
+            ax: dict(ops) for ax, ops in by_axis.items()}
+        out["permute_leak_bytes_off_pipe"] = (
+            by_axis.get("data", {}).get("collective-permute", 0)
+            + by_axis.get("model", {}).get("collective-permute", 0))
+
+    out["gate"] = {"metric": f"pipeline-1f1b-vs-host-gpipe-S{S}",
+                   "value": out["f1b_vs_host_gpipe_paired"],
+                   "target": 1.0,
+                   "ok": out["f1b_vs_host_gpipe_paired"] > 1.0}
     return out
 
 

@@ -53,10 +53,22 @@ class ShardingStrategy:
     # moments (data, model)-sharded, param allgather rides ONLY the data
     # axis)
     ZERO1_TP = "zero1_tp"
+    # mesh-native 1F1B pipeline parallelism (parallel/pipeline.py
+    # PipelinePlan + make_pp_step): the model's homogeneous layer run is
+    # stage-stacked on a leading axis sharded over "pipe" and the whole
+    # M-microbatch schedule is ONE jitted SPMD program (collective-permute
+    # activation handoffs ride only the pipe axis). PP requires
+    # data=model=1; ZERO1_TP_PP composes all three axes — params
+    # (pipe, model)-sharded, moments additionally sharded over "data",
+    # the trailing param allgather riding ONLY the data axis.
+    PP = "pp"
+    ZERO1_TP_PP = "zero1_tp_pp"
 
     #: strategies under which every device holds the full params between
     #: steps (evaluation/scoring may pull a host-local copy safely).
-    #: ZERO1_TP is NOT here: its params live model-sharded.
+    #: ZERO1_TP is NOT here: its params live model-sharded. The pipeline
+    #: strategies are NOT here either: their stage params live stacked
+    #: and pipe-sharded (unstacked only by publish_view/_sync_back).
     PARAMS_REPLICATED = (REPLICATED, ZERO1, ZERO2)
 
 

@@ -67,16 +67,22 @@ _MODE_STRATEGIES = {
         ShardingStrategy.REPLICATED, ShardingStrategy.TENSOR_PARALLEL,
         ShardingStrategy.FSDP, ShardingStrategy.ZERO1,
         ShardingStrategy.ZERO2, ShardingStrategy.ZERO1_TP,
-        ShardingStrategy.PIPELINE),
+        ShardingStrategy.PIPELINE, ShardingStrategy.PP,
+        ShardingStrategy.ZERO1_TP_PP),
     TrainingMode.AVERAGING: (ShardingStrategy.REPLICATED,),
 }
+
+#: the mesh-native 1F1B strategies (ISSUE 15): one jitted SPMD program
+#: per optimizer step on a (data, model, pipe) mesh
+_PP_STRATEGIES = (ShardingStrategy.PP, ShardingStrategy.ZERO1_TP_PP)
 
 #: strategies that compose with a 2-D (data, model) mesh (model axis
 #: size > 1): replicated ignores the model axis (baseline arm of the
 #: mesh2d ablations), tensor_parallel is DP×TP, zero1_tp is ZeRO-1×TP
 _MESH2D_STRATEGIES = (ShardingStrategy.REPLICATED,
                       ShardingStrategy.TENSOR_PARALLEL,
-                      ShardingStrategy.ZERO1_TP)
+                      ShardingStrategy.ZERO1_TP,
+                      ShardingStrategy.ZERO1_TP_PP)
 
 #: why each remaining strategy is NOT a 2-D citizen (the actionable half
 #: of the rejection message)
@@ -99,7 +105,9 @@ _MESH2D_HINTS = {
 
 
 def _validate_mode_strategy(mode: str, strategy: str, mesh=None,
-                            model_axis: str = MeshAxes.MODEL) -> None:
+                            model_axis: str = MeshAxes.MODEL,
+                            data_axis: str = MeshAxes.DATA,
+                            pipe_axis: str = MeshAxes.PIPE) -> None:
     """One actionable error for every unsupported (mode, strategy,
     mesh-shape) combination — raised before any mesh/model work instead
     of failing deep in _prepare (or as a KeyError inside param_specs)."""
@@ -127,6 +135,7 @@ def _validate_mode_strategy(mode: str, strategy: str, mesh=None,
         return
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     model_size = int(axes.get(model_axis, 1))
+    pipe_size = int(axes.get(pipe_axis, 1))
     if strategy in (ShardingStrategy.TENSOR_PARALLEL,
                     ShardingStrategy.ZERO1_TP) \
             and model_axis not in mesh.axis_names:
@@ -135,6 +144,26 @@ def _validate_mode_strategy(mode: str, strategy: str, mesh=None,
             f"mesh axis, but the mesh only carries {mesh.axis_names}. "
             "Build a 2-D mesh: ParallelTrainer(model, mesh_shape=(d, m)) "
             "or mesh=make_mesh({'data': d, 'model': m})")
+    if strategy in _PP_STRATEGIES:
+        if pipe_axis not in mesh.axis_names or pipe_size < 2:
+            raise ValueError(
+                f"strategy='{strategy}' stages the model over a "
+                f"'{pipe_axis}' mesh axis of size >= 2, but the mesh "
+                f"carries {dict(axes)}. Build a 3-D mesh: "
+                "ParallelTrainer(model, mesh_shape=(d, m, p))")
+        if strategy == ShardingStrategy.PP \
+                and (int(axes.get(data_axis, 1)) > 1 or model_size > 1):
+            raise ValueError(
+                f"strategy='pp' is the pure pipeline (data=model=1); the "
+                f"mesh carries {dict(axes)} — use strategy='zero1_tp_pp' "
+                "to compose data/model axes with the pipeline")
+    elif pipe_size > 1 and strategy != ShardingStrategy.PIPELINE:
+        raise ValueError(
+            f"the mesh carries a '{pipe_axis}' axis of size {pipe_size}, "
+            f"but strategy='{strategy}' does not stage over it — use "
+            "strategy='pp' or 'zero1_tp_pp' (mesh-native 1F1B), "
+            "strategy='pipeline' (host-driven GPipe), or drop the pipe "
+            "axis")
     if model_size > 1:
         if mode == TrainingMode.AVERAGING:
             raise ValueError(
@@ -170,6 +199,8 @@ class ParallelTrainer:
         could serve pre-rollback params at a reused key — drop them."""
         self._host_cache = None
         self._eval_cache = None
+        self._pp_pub_iter = None
+        self._pp_pub_iter = None
 
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: str = TrainingMode.SYNC,
@@ -183,18 +214,35 @@ class ParallelTrainer:
                  zero_reduce_dtype: Optional[str] = None,
                  mesh_shape: Optional[tuple] = None):
         if mesh_shape is not None:
-            # 2-D shorthand (ISSUE 14): mesh_shape=(d, m) builds the
-            # (data, model) mesh — d-way ZeRO/data parallelism × m-way
-            # Megatron tensor parallelism on d·m devices
+            # mesh shorthand: (d, m) builds the 2-D (data, model) mesh
+            # (ISSUE 14); (d, m, p) the 3-D (data, model, pipe) mesh for
+            # the 1F1B pipeline strategies (ISSUE 15) — d-way ZeRO/data
+            # parallelism × m-way Megatron tensor parallelism × p-way
+            # pipeline stages on d·m·p devices
             if mesh is not None:
-                raise ValueError("pass mesh= OR mesh_shape=(d, m), not both")
-            if len(mesh_shape) != 2:
                 raise ValueError(
-                    f"mesh_shape must be (data, model), got {mesh_shape!r}")
-            mesh = make_mesh({data_axis: int(mesh_shape[0]),
-                              model_axis: int(mesh_shape[1])})
+                    "pass mesh= OR mesh_shape=(d, m[, p]), not both")
+            if len(mesh_shape) == 2:
+                axes = {data_axis: int(mesh_shape[0]),
+                        model_axis: int(mesh_shape[1])}
+            elif len(mesh_shape) == 3:
+                axes = {data_axis: int(mesh_shape[0]),
+                        model_axis: int(mesh_shape[1]),
+                        MeshAxes.PIPE: int(mesh_shape[2])}
+            else:
+                raise ValueError(
+                    "mesh_shape must be (data, model) or (data, model, "
+                    f"pipe), got {mesh_shape!r}")
+            # a product smaller than the device count uses the FIRST
+            # d·m[·p] devices (e.g. mesh_shape=(1, 1, 4) on the 8-dev
+            # CPU mesh); make_mesh still rejects a product larger than
+            # the machine
+            total = int(np.prod(list(axes.values())))
+            devs = jax.devices()
+            mesh = make_mesh(axes, devices=devs[:total]
+                             if 0 < total < len(devs) else None)
         mesh = mesh if mesh is not None else make_mesh()
-        _validate_mode_strategy(mode, strategy, mesh, model_axis)
+        _validate_mode_strategy(mode, strategy, mesh, model_axis, data_axis)
         if (strategy not in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2)
                 and (zero_bucket_mb is not None
                      or zero_reduce_dtype is not None)):
@@ -245,10 +293,10 @@ class ParallelTrainer:
         self._zero_info = None
         self._host_cache = None
         self._eval_cache = None
+        self._pp_pub_iter = None
         if strategy == ShardingStrategy.PIPELINE:
             # stage-partitioned training of a real MultiLayerNetwork: the
             # mesh must carry a "pipe" axis; delegate to the GPipe trainer
-            from .mesh import MeshAxes
             from .pipeline import (PipelinedGraphTrainer,
                                    PipelinedNetworkTrainer)
             from ..nn.graph import ComputationGraph
@@ -261,8 +309,12 @@ class ParallelTrainer:
             self._pipe = cls(model, self.mesh, axis=axis)
             self.n_data = 1
             self.iteration_count = 0
+            self._pp_plan = None
+            self._rng = self._pipe._rng
             return
         self._pipe = None
+        self._pp_plan = None
+        self._pp_zero_plan = None
         self.n_data = self.mesh.shape[data_axis]
         if mode == TrainingMode.AVERAGING and jax.process_count() > 1:
             # the multi-host dataset plane (global_batch_array assembly)
@@ -278,6 +330,21 @@ class ParallelTrainer:
 
     # ------------------------------------------------------------------
     def _prepare(self):
+        if self._pipe is not None:
+            # legacy host-GPipe: re-place the model's (restored) trees on
+            # the stage devices — the checkpoint-restore path
+            # (_ShardedTrainerStore.restore) re-prepares through here
+            p = self._pipe
+            p._place_params()
+            p.iteration_count = int(self.model.iteration_count)
+            p._score = float("nan")
+            self.iteration_count = p.iteration_count
+            rng = getattr(self.model, "_rng", None)
+            p._rng = rng if rng is not None else jax.random.PRNGKey(0)
+            self._rng = p._rng
+            self._host_cache = None
+            self._eval_cache = None
+            return
         m = self.model
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
@@ -287,7 +354,63 @@ class ParallelTrainer:
         self._repl = repl
         self._batch_sh = batch_sh
         self._p_sh = repl
-        if self.mode == TrainingMode.SYNC and self.strategy in (
+        self._s_sh = repl
+        if self.mode == TrainingMode.SYNC \
+                and self.strategy in _PP_STRATEGIES:
+            # mesh-native 1F1B (ISSUE 15): the model's homogeneous layer
+            # run is stage-stacked and pipe-sharded; the trainer-resident
+            # trees live in pp form ({"head", "stack", "tail"}) — the
+            # step is ONE jitted SPMD program per optimizer step.
+            # ZERO1_TP_PP additionally TP-shards params over `model` and
+            # ZeRO-1-shards the optimizer moments over `data` (the
+            # trailing param allgather rides ONLY the data axis).
+            from .pipeline import PipelinePlan, make_pp_step
+            from .sharding import _opt_sharding_like
+
+            two_d = self.strategy == ShardingStrategy.ZERO1_TP_PP
+            plan = PipelinePlan(m, mesh, pipe_axis=MeshAxes.PIPE,
+                                model_axis=self.model_axis,
+                                data_axis=self.data_axis, tp=two_d)
+            self._pp_plan = plan
+            p_specs = plan.param_specs()
+            p_sh = plan.shardings(p_specs)
+            s_sh = plan.shardings(plan.state_specs())
+            params_pp = plan.stack(m.params)
+            state_pp = plan.stack(m.state)
+            opt_pp = plan.stack(m.updater_state)
+            zero_plan = None
+            if two_d:
+                from .zero import ZeroConfig, _ZeroPlan
+                zero_plan = _ZeroPlan(m, mesh, self.data_axis,
+                                      ZeroConfig(stage=1),
+                                      base_specs=p_specs,
+                                      model_axis=self.model_axis,
+                                      params=params_pp, opt_state=opt_pp)
+                o_sh = zero_plan.opt_shardings_tree
+                self._zero_info = dict(zero_plan.info)
+                self._zero_info["expected_constraints"] = \
+                    zero_plan.expected_constraints()
+            else:
+                o_sh = _opt_sharding_like(opt_pp, params_pp, p_sh)
+            self._pp_zero_plan = zero_plan
+            step_fn, self._pp_info = make_pp_step(m, plan,
+                                                  zero_plan=zero_plan)
+            self._p_sh = p_sh
+            self._s_sh = s_sh
+            self._o_sh = o_sh
+            self._params = jax.device_put(params_pp, p_sh)
+            self._state = jax.device_put(state_pp, s_sh)
+            self._opt = jax.device_put(opt_pp, o_sh)
+            self._raw_step_fn = step_fn
+            self._step_fn = watch_compiles(jax.jit(
+                step_fn,
+                in_shardings=(p_sh, s_sh, o_sh, repl, batch_sh, batch_sh,
+                              repl, batch_sh, batch_sh),
+                out_shardings=(p_sh, s_sh, o_sh, repl),
+                donate_argnums=(0, 1, 2)),
+                "parallel/zero1_tp_pp_step" if two_d
+                else "parallel/pp_step")
+        elif self.mode == TrainingMode.SYNC and self.strategy in (
                 ShardingStrategy.ZERO1, ShardingStrategy.ZERO2,
                 ShardingStrategy.ZERO1_TP):
             # ZeRO: params replicated between steps, optimizer moments
@@ -448,6 +571,7 @@ class ParallelTrainer:
         # a possibly-identical iteration count
         self._host_cache = None
         self._eval_cache = None
+        self._pp_pub_iter = None
         # a restore re-prepares with a fresh raw step closure; drop the
         # cached superstep jits so they can't capture the stale one
         self.__dict__.pop("_superstep_jit", None)
@@ -507,22 +631,9 @@ class ParallelTrainer:
         from ..nn.superstep import validate_grad_accumulation
         accum_m = validate_grad_accumulation(grad_accumulation)
         if self._pipe is not None:
-            if checkpoint_dir is not None or resume or guard is not None:
-                raise ValueError(
-                    "checkpoint/resume/guard are not supported for the "
-                    "PIPELINE strategy (stage-partitioned params live in "
-                    "the pipe trainer); checkpoint the wrapped model via "
-                    "ModelSerializer after fit instead")
-            if accum_m != 1:
-                raise ValueError(
-                    f"grad_accumulation={accum_m} is not supported for "
-                    "the PIPELINE strategy (its GPipe schedule already "
-                    "microbatches; use n_microbatches on the pipe "
-                    "trainer)")
-            self._pipe.fit(data, epochs=epochs)
-            self.iteration_count = self._pipe.iteration_count
-            self._pipe.sync_back()
-            return self
+            return self._fit_pipe(data, epochs, accum_m, prefetch,
+                                  pad_ragged, time_buckets, checkpoint_dir,
+                                  checkpoint_every, resume, guard)
         if isinstance(data, (DataSet, MultiDataSet)):
             if checkpoint_dir is not None or resume:
                 raise ValueError(
@@ -589,6 +700,79 @@ class ParallelTrainer:
         self._sync_back()
         return self
 
+    def _fit_pipe(self, data, epochs, accum_m, prefetch, pad_ragged,
+                  time_buckets, checkpoint_dir, checkpoint_every, resume,
+                  guard):
+        """fit() for the legacy host-GPipe PIPELINE strategy. The
+        fault knobs route through the standard sharded store (ISSUE 15
+        satellite — PR 5's blanket rejection lifted): the GPipe step has
+        clean optimizer-step boundaries, saves publish the synced-back
+        model, restores re-place the stage params (`_prepare`) and skip
+        the trained prefix — kill-mid-write resume is bit-exact like
+        every other strategy. `pad_ragged` pads ragged final batches
+        with weight-zero label-mask rows the last-stage loss consumes."""
+        if guard is not None:
+            raise ValueError(
+                "guard is not supported for the host-driven PIPELINE "
+                "strategy (per-stage dispatch has no whole-step snapshot "
+                "boundary); use strategy='pp'/'zero1_tp_pp' (mesh-native "
+                "1F1B) for guarded pipeline training")
+        if accum_m != 1:
+            raise ValueError(
+                f"grad_accumulation={accum_m} is not supported for "
+                "the PIPELINE strategy (its GPipe schedule already "
+                "microbatches; use n_microbatches on the pipe "
+                "trainer)")
+        if isinstance(data, (DataSet, MultiDataSet)):
+            if checkpoint_dir is not None or resume:
+                raise ValueError(
+                    "checkpoint_dir/resume need an iterator fit (the "
+                    "checkpoint records epoch/batch progress)")
+            self._pipe.fit(data, epochs=epochs)
+            self.iteration_count = self._pipe.iteration_count
+            self._rng = self._pipe._rng
+            self._pipe.sync_back()
+            return self
+        from ..datasets.pipeline import build_pipeline
+        from ..fault.resume import sharded_fit_checkpointer
+
+        ckpt = sharded_fit_checkpointer(self, checkpoint_dir,
+                                        checkpoint_every, resume)
+        skip, done_epochs = (0, 0) if ckpt is None else \
+            ckpt.resume_into(data)
+        # a restore reinstated self._rng/iteration_count — push them into
+        # the pipe trainer so the resumed PRNG/step chain continues
+        self._pipe._rng = self._rng
+        self._pipe.iteration_count = self.iteration_count
+        data, close = build_pipeline(data, pad_ragged=pad_ragged,
+                                     prefetch=prefetch,
+                                     time_buckets=time_buckets)
+        sigterm = (ckpt.sigterm_snapshot() if ckpt is not None
+                   else _null_span())
+        try:
+            with sigterm:
+                for _ in range(max(0, epochs - done_epochs)):
+                    data.reset()
+                    while data.has_next():
+                        ds = data.next()
+                        if skip:
+                            skip -= 1   # resume: prefix already trained
+                            continue
+                        self._pipe._fit_batch(ds)
+                        self.iteration_count = self._pipe.iteration_count
+                        self._rng = self._pipe._rng
+                        if ckpt is not None:
+                            ckpt.on_batch()
+                    if ckpt is not None:
+                        ckpt.on_epoch()
+                if ckpt is not None:
+                    ckpt.on_fit_end()
+        finally:
+            close()
+        self._pipe.sync_back()
+        self.model.iteration_count = self.iteration_count
+        return self
+
     def _make_superstep_runner(self, superstep, guard, ckpt, accum_m=1):
         """SuperstepRunner composing the window scan with the sharded SYNC
         step, or None for per-batch dispatch (superstep=1 with
@@ -639,9 +823,9 @@ class ParallelTrainer:
         repl = self._repl
         return watch_compiles(jax.jit(
             build_superstep(self._raw_step_fn),
-            in_shardings=(self._p_sh, repl, self._o_sh, repl, repl,
+            in_shardings=(self._p_sh, self._s_sh, self._o_sh, repl, repl,
                           win, win, win, win),
-            out_shardings=(self._p_sh, repl, self._o_sh, repl, repl),
+            out_shardings=(self._p_sh, self._s_sh, self._o_sh, repl, repl),
             donate_argnums=(0, 1, 2)), "parallel/superstep")
 
     def _accum_superstep_jit(self, skip_nonfinite: bool):
@@ -657,8 +841,25 @@ class ParallelTrainer:
         fn = cache.get(bool(skip_nonfinite))
         if fn is not None:
             return fn
-        if self.strategy in (ShardingStrategy.ZERO1, ShardingStrategy.ZERO2,
-                             ShardingStrategy.ZERO1_TP):
+        if self.strategy in _PP_STRATEGIES:
+            # the pipeline's microbatches ARE the accumulation
+            # microbatches: a [K, M, b, ...] window runs K optimizer
+            # steps, each one M-microbatch 1F1B schedule, in ONE dispatch
+            from .pipeline import make_pp_accum_superstep
+            if skip_nonfinite:
+                raise ValueError(
+                    "guard policy 'skip_batch' cannot neutralize single "
+                    "microbatches inside the 1F1B schedule (the pipeline "
+                    "interleaves them); use warn/rollback/halt with the "
+                    "pipeline strategies")
+            raw, _info = make_pp_accum_superstep(
+                self.model, self._pp_plan, zero_plan=self._pp_zero_plan)
+            name = ("parallel/zero1_tp_pp_accum_superstep"
+                    if self.strategy == ShardingStrategy.ZERO1_TP_PP
+                    else "parallel/pp_accum_superstep")
+        elif self.strategy in (ShardingStrategy.ZERO1,
+                               ShardingStrategy.ZERO2,
+                               ShardingStrategy.ZERO1_TP):
             from .sharding import model_layer_hints
             from .zero import (DEFAULT_BUCKET_MB, ZeroConfig,
                                make_zero_accum_superstep)
@@ -690,9 +891,10 @@ class ParallelTrainer:
         repl = self._repl
         fn = watch_compiles(jax.jit(
             raw,
-            in_shardings=(self._p_sh, repl, self._o_sh, repl, repl,
+            in_shardings=(self._p_sh, self._s_sh, self._o_sh, repl, repl,
                           win, win, win, win),
-            out_shardings=(self._p_sh, repl, self._o_sh, repl, repl, repl),
+            out_shardings=(self._p_sh, self._s_sh, self._o_sh, repl, repl,
+                           repl),
             donate_argnums=(0, 1, 2)), name)
         cache[bool(skip_nonfinite)] = fn
         return fn
@@ -934,6 +1136,12 @@ class ParallelTrainer:
         if self._pipe is not None:
             self._pipe.sync_back()
             return self.model.score(ds)
+        if self._pp_plan is not None:
+            # stage-stacked params: publish a per-layer view and score on
+            # the reassembled model (host memory caveat documented in the
+            # README pipeline section)
+            self.publish_view()
+            return self.model.score(ds)
         if jax.process_count() > 1:
             # each process scores its row share; the weighted mean is
             # allreduced so EVERY process returns the identical global
@@ -1166,10 +1374,14 @@ class ParallelTrainer:
         returns the merged Evaluation, identical on every process."""
         from ..eval import Evaluation
 
-        if self._pipe is not None:
-            # stage-partitioned params live in the pipe trainer; publish and
-            # evaluate on the reassembled model
-            self._pipe.sync_back()
+        if self._pipe is not None or self._pp_plan is not None:
+            # stage-partitioned/stacked params: publish and evaluate on
+            # the reassembled model
+            from ..datasets.iterators import ListDataSetIterator
+
+            self.publish_view()
+            if isinstance(data, DataSet):
+                data = ListDataSetIterator([data])
             return self.model.evaluate(data, labels_list=labels_list,
                                        top_n=top_n)
         ev = Evaluation(labels=labels_list, top_n=top_n)
@@ -1212,8 +1424,8 @@ class ParallelTrainer:
         `_local_rows`) and the rows are allgathered in process order, so
         every process returns the identical global array with one row per
         example."""
-        if self._pipe is not None:
-            self._pipe.sync_back()
+        if self._pipe is not None or self._pp_plan is not None:
+            self.publish_view()
             return self.model.score_examples(data, add_regularization_terms)
         multi = jax.process_count() > 1
         outs = []
@@ -1321,6 +1533,25 @@ class ParallelTrainer:
         mode collapses the live replicas to their mean, destroying the
         local-SGD window). Used by checkpointing and best-model saving;
         returns the wrapped model."""
+        if self._pipe is not None:
+            self._pipe.sync_back()
+            self.model.iteration_count = self._pipe.iteration_count
+            return self.model
+        if self._pp_plan is not None:
+            # pp-form trees -> the model's per-layer tuples (host-side
+            # unstack; the live pipe-sharded buffers stay untouched).
+            # Cached per trained step — score/evaluate between fits must
+            # not re-pay the whole-model host round-trip (the pp analog
+            # of _host_view; invalidated by _prepare and the guard's
+            # _fault_restored rollback hook)
+            if self._pp_pub_iter != self.iteration_count:
+                plan = self._pp_plan
+                self.model.params = plan.unstack_host(self._params)
+                self.model.state = plan.unstack_host(self._state)
+                self.model.updater_state = plan.unstack_host(self._opt)
+                self._pp_pub_iter = self.iteration_count
+            self.model.iteration_count = self.iteration_count
+            return self.model
         if self.mode == TrainingMode.SYNC:
             self.model.params = self._params
             self.model.state = self._state
@@ -1336,6 +1567,9 @@ class ParallelTrainer:
 
     def _sync_back(self):
         """Write averaged/replicated params back into the wrapped model."""
+        if self._pp_plan is not None:
+            self.publish_view()
+            return
         if self.mode == TrainingMode.SYNC:
             self.model.params = self._params
             self.model.state = self._state

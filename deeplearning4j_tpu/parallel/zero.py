@@ -122,6 +122,11 @@ def _add_data_axis(spec: P, shape, data_axis: str, mesh: Mesh) -> P:
     P(("model", "data")) — 1/(m·d) per device). Leaves with no divisible
     home stay at the base spec (their update cost is noise)."""
     d = int(mesh.shape[data_axis])
+    if d <= 1:
+        # a degenerate data axis shards nothing; adding it would only
+        # perturb the specs away from the base layout (GSPMD then pays
+        # rematerializations to "reshard" onto the size-1 axis)
+        return spec
     entries = list(tuple(spec)) + [None] * (len(shape) - len(tuple(spec)))
     free = [i for i, e in enumerate(entries) if e is None]
     for ax in sorted(free, key=lambda i: -shape[i]):
@@ -226,7 +231,18 @@ class _ZeroPlan:
 
     def __init__(self, model, mesh: Mesh, data_axis: str,
                  config: ZeroConfig, base_specs=None,
-                 model_axis: Optional[str] = None):
+                 model_axis: Optional[str] = None,
+                 params=None, opt_state=None):
+        # `params`/`opt_state` override the model's own trees when the
+        # caller trains a RESTRUCTURED view of the model — the pipeline
+        # strategies (parallel/pipeline.py) hand the stage-stacked
+        # pp-form trees here, so the ZeRO layout/accounting applies to
+        # the buffers the step actually carries. The updater-contract
+        # check still runs against the model (same updaters either way).
+        if params is None:
+            params = model.params
+        if opt_state is None:
+            opt_state = model.updater_state
         if config.stage not in (1, 2):
             raise ValueError(
                 f"ZeRO stage must be 1 or 2, got {config.stage}")
@@ -248,11 +264,11 @@ class _ZeroPlan:
         self.config = config
 
         # ---- static layout: one spec/sharding per param leaf ------------
-        leaves, self.treedef = jax.tree_util.tree_flatten(model.params)
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
         base_leaves = (jax.tree_util.tree_leaves(base_specs, is_leaf=_is_p)
                        if base_specs is not None else [P()] * len(leaves))
         specs = jax.tree_util.tree_leaves(
-            zero_grad_specs(model.params, mesh, data_axis,
+            zero_grad_specs(params, mesh, data_axis,
                             base=base_specs), is_leaf=_is_p)
         self.shardings = [NamedSharding(mesh, s) for s in specs]
         shapes = [np.shape(l) for l in leaves]
@@ -334,10 +350,11 @@ class _ZeroPlan:
         }
 
         # optimizer-state constraints (same specs, matched by shape)
-        opt_sh_tree = zero_opt_shardings(model.updater_state, model.params,
+        opt_sh_tree = zero_opt_shardings(opt_state, params,
                                          mesh, data_axis, base=base_specs)
         self.opt_sh_leaves = jax.tree_util.tree_leaves(opt_sh_tree)
-        self.opt_treedef = jax.tree_util.tree_structure(model.updater_state)
+        self.opt_treedef = jax.tree_util.tree_structure(opt_state)
+        self.opt_shardings_tree = opt_sh_tree
 
     def expected_constraints(self, accum: bool = False) -> int:
         """The number of `with_sharding_constraint` applications the plan

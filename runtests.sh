@@ -33,6 +33,15 @@
 #                            accum-vs-native bench rep on the 8-dev mesh
 #                            (throughput ratio, accumulator memory,
 #                            overlap fraction)
+#   ./runtests.sh pipe       mesh-native 1F1B pipeline smoke: the
+#                            pp/zero1_tp_pp equivalence suite (1F1B vs
+#                            single-process accumulation on both 3-D
+#                            reshapes, grouping invariance, masks,
+#                            kill-mid-write resume, IR seeded
+#                            mutations) plus one paired 1F1B-vs-host-
+#                            GPipe transformer-LM bench rep (tokens/s,
+#                            dispatch-span share, per-axis collective
+#                            payloads JSON)
 #   ./runtests.sh mesh2d     2-D mesh-parallelism smoke: the ZERO1×TP
 #                            equivalence suite (vs replicated and 1-D
 #                            ZERO1, superstep/accumulation grouping
@@ -115,6 +124,15 @@ fi
 if [[ "${1:-}" == "pipeline" ]]; then
     echo "=== input-pipeline smoke ==="
     exec python -m pytest tests/test_input_pipeline.py -q
+fi
+if [[ "${1:-}" == "pipe" ]]; then
+    echo "=== mesh-native 1F1B pipeline equivalence smoke ==="
+    python -m pytest tests/test_pipeline_1f1b.py -q
+    echo "=== paired 1F1B-vs-host-GPipe bench rep (transformer LM) ==="
+    exec env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --mode pipeline --steps 2 --reps 2
 fi
 runs="${1:-1}"
 for i in $(seq 1 "$runs"); do

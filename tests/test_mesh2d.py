@@ -308,6 +308,10 @@ def test_mesh_shape_knob_validation():
         ParallelTrainer(_model(), mesh=make_mesh({"data": 8}),
                         mesh_shape=(2, 4))
     with pytest.raises(ValueError, match=r"\(data, model\)"):
+        ParallelTrainer(_model(), mesh_shape=(2, 2, 2, 1))
+    # a 3-tuple now builds the 3-D (data, model, pipe) mesh (ISSUE 15);
+    # non-pipeline strategies reject the pipe axis up front
+    with pytest.raises(ValueError, match="pipe"):
         ParallelTrainer(_model(), mesh_shape=(2, 2, 2))
 
 

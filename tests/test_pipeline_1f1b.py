@@ -1,0 +1,538 @@
+"""Mesh-native 1F1B pipeline parallelism (ISSUE 15, parallel/pipeline.py
+PipelinePlan + make_pp_step/make_pp_accum_superstep).
+
+The acceptance pattern extends test_mesh2d's: the 1F1B strategies must be
+PARAMETER-EQUIVALENT (f32-ulp — the stage-batched matmuls reassociate) to
+single-process `fit(grad_accumulation=M)` on the identical microbatches,
+on BOTH (d, m, p) reshapes of the 8-device mesh; grouping across
+superstep K × microbatch M must not change the math; the weight-zero
+label mask (pad_ragged) must thread through the last-stage loss; the
+fault plane must compose (kill mid-sharded-save, resume bit-exact, pipe
+layouts re-landing) for the 1F1B strategies AND the legacy host-GPipe
+strategy whose blanket rejection PR 5 introduced; indivisible
+depth/microbatch counts must be rejected up front with one actionable
+message; and the IR tier's pipeline contract must be live (seeded
+mutations: dropped stage constraint -> constraint hit, a permute riding
+the data axis -> per-axis byte hit).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer,
+                                EmbeddingSequenceLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer,
+                                TransformerBlock)
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.pipeline import PadToBatchIterator
+from deeplearning4j_tpu.fault.injection import SimulatedCrash, crash_at_write
+from deeplearning4j_tpu.parallel import (ParallelTrainer, ShardedCheckpoint,
+                                         ShardingStrategy, make_mesh)
+
+pytestmark = pytest.mark.sanitize
+
+
+def _mlp(seed=7, h=16, depth=4):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2)).list()
+    for _ in range(depth):
+        b = b.layer(DenseLayer(n_out=h, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(h)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lm(seed=0, vocab=32, width=16, t=8, depth=4, heads=4):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+         .list()
+         .layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width)))
+    for _ in range(depth):
+        b = b.layer(TransformerBlock(n_heads=heads))
+    conf = (b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, t)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+_r = np.random.default_rng(0)
+
+
+def _micro(mb=8, h=16, seed=None):
+    r = _r if seed is None else np.random.default_rng(seed)
+    return DataSet(r.normal(size=(mb, h)).astype(np.float32),
+                   np.eye(4, dtype=np.float32)[r.integers(0, 4, mb)])
+
+
+def _lm_micro(mb=8, t=8, vocab=32):
+    return DataSet(
+        _r.integers(0, vocab, (mb, t, 1)).astype(np.float32),
+        np.eye(vocab, dtype=np.float32)[_r.integers(0, vocab, (mb, t))])
+
+
+def _micros(n, seed=0, mb=8):
+    r = np.random.default_rng(seed)
+    return [DataSet(r.normal(size=(mb, 16)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[r.integers(0, 4, mb)])
+            for _ in range(n)]
+
+
+def _flat(model):
+    return np.asarray(model.params_flat())
+
+
+def _specs(tree):
+    return [tuple(l.sharding.spec) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _axes_used(spec):
+    out = set()
+    for e in spec:
+        if e is None:
+            continue
+        out.update(e if isinstance(e, tuple) else (e,))
+    return out
+
+
+# ======================================================================
+# equivalence: 1F1B == single-process grad accumulation, both reshapes
+# ======================================================================
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4)])
+def test_1f1b_matches_single_process_accumulation(shape):
+    """The M-microbatch 1F1B step trains parameter-equivalent (f32-ulp)
+    to fit(grad_accumulation=M) on the identical microbatch stream —
+    the same per-micro RNG chain, masked-mean losses and update math,
+    only the stage-batched matmul association differs."""
+    M = 4
+    micros = _micros(3 * M)
+    ref = _mlp()
+    ref.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    tr = ParallelTrainer(_mlp(), mesh_shape=shape,
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    assert tr.iteration_count == ref.iteration_count == 3
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pure_pp_matches_single_process():
+    """strategy='pp' on the pure (1, 1, 8) pipe mesh (depth-8 stage run,
+    one layer per stage)."""
+    M = 4
+    micros = _micros(2 * M)
+    ref = _mlp(depth=8)
+    ref.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    tr = ParallelTrainer(_mlp(depth=8), mesh_shape=(1, 1, 8),
+                         strategy=ShardingStrategy.PP)
+    tr.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_transformer_lm_1f1b_matches_single_process():
+    """The flagship composition: TransformerBlock depth as the stage
+    unit, vocab-sharded embedding head and RnnOutput tail replicated
+    over pipe, TP roles on the model axis."""
+    M = 4
+    micros = [_lm_micro() for _ in range(2 * M)]
+    ref = _lm()
+    ref.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    tr = ParallelTrainer(_lm(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(list(micros)), grad_accumulation=M)
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=1e-3, atol=5e-5)
+
+
+def test_per_batch_pp_step_matches_single_process():
+    """Without grad_accumulation the per-batch pp step (M = 1 pipeline)
+    still matches the plain per-batch fit."""
+    micros = _micros(4)
+    ref = _mlp()
+    ref.fit(ListDataSetIterator(list(micros)))
+    tr = ParallelTrainer(_mlp(), mesh_shape=(1, 2, 4),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(list(micros)))
+    assert tr.iteration_count == 4
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+# ======================================================================
+# layouts + dispatch shape
+# ======================================================================
+
+def test_stage_params_land_pipe_sharded():
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    stack_axes = set().union(*(_axes_used(s)
+                               for s in _specs(tr._params["stack"])))
+    assert "pipe" in stack_axes
+    # moments additionally shard over data (ZeRO-1)
+    opt_axes = set().union(*(_axes_used(s)
+                             for s in _specs(tr._opt["stack"])))
+    assert {"pipe", "data"} <= opt_axes
+    # head/tail params never ride the pipe axis
+    ht = list(_specs(tr._params["head"])) + list(_specs(tr._params["tail"]))
+    assert all("pipe" not in _axes_used(s) for s in ht)
+
+
+def test_one_dispatch_per_step_signature():
+    """The M-microbatch optimizer step is ONE watched jit entry (the
+    accum superstep family) — per-step dispatch is O(1), not
+    O(stages·microbatches)."""
+    from deeplearning4j_tpu.telemetry import runtime as tel
+
+    sess = tel.enable()
+    try:
+        tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                             strategy=ShardingStrategy.ZERO1_TP_PP)
+        tr.fit(ListDataSetIterator(_micros(12)), grad_accumulation=4)
+        rep = {k: v["count"] for k, v in sess.compiles.report().items()
+               if v["count"]}
+        assert set(rep) == {"parallel/zero1_tp_pp_accum_superstep"}
+        # steady state: one compile per (mesh, M) signature (+1 for the
+        # first call's uncommitted->committed arg transition, the same
+        # behavior every SYNC strategy shows)
+        assert rep["parallel/zero1_tp_pp_accum_superstep"] <= 2
+        fn = tr._accum_superstep_jit(False).__wrapped__
+        assert fn._cache_size() <= 2
+    finally:
+        tel.disable()
+
+
+def test_permutes_ride_only_the_pipe_axis():
+    """Compiled-HLO collective-permutes of the 1F1B step attribute to
+    the pipe axis (or multi-axis GSPMD reshard shuffles under "other")
+    — never to data/model alone (the leak the IR budgets catch)."""
+    from deeplearning4j_tpu.analysis.ir import (
+        measured_collective_bytes_by_axis)
+
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 1, 4),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    fn = tr._accum_superstep_jit(False).__wrapped__
+    xs = jnp.zeros((1, 4, 8, 16), jnp.float32)
+    ys = jnp.zeros((1, 4, 8, 4), jnp.float32)
+    text = fn.trace(tr._params, tr._state, tr._opt,
+                    jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                    xs, ys, None, None).lower().compile().as_text()
+    by = measured_collective_bytes_by_axis(
+        text, {"data": 2, "model": 1, "pipe": 4})
+    assert by.get("pipe", {}).get("collective-permute", 0) > 0
+    assert by.get("data", {}).get("collective-permute", 0) == 0
+    assert by.get("model", {}).get("collective-permute", 0) == 0
+
+
+# ======================================================================
+# grouping invariance: superstep K × microbatch M
+# ======================================================================
+
+def test_superstep_grouping_invariant():
+    """K=2 windows of the accum superstep == K=1 per-step dispatches at
+    f32-ulp (the PR 12 ZeRO-strategy contract: grouping never changes
+    the math, but XLA reassociates the scan body's collectives across
+    window lengths)."""
+    micros = _micros(8)
+    a = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                        strategy=ShardingStrategy.ZERO1_TP_PP)
+    a.fit(ListDataSetIterator(list(micros)), grad_accumulation=4,
+          superstep=2)
+    b = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                        strategy=ShardingStrategy.ZERO1_TP_PP)
+    b.fit(ListDataSetIterator(list(micros)), grad_accumulation=4,
+          superstep=1)
+    np.testing.assert_allclose(_flat(a.publish_view()),
+                               _flat(b.publish_view()), rtol=2e-5,
+                               atol=2e-7)
+
+
+def test_ragged_tail_group_renormalizes():
+    """6 microbatches at M=4 train as [4] + [2] — the tail group is one
+    renormalized optimizer step, exactly like single-process
+    accumulation."""
+    micros = _micros(6)
+    ref = _mlp()
+    ref.fit(ListDataSetIterator(list(micros)), grad_accumulation=4)
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(list(micros)), grad_accumulation=4)
+    assert tr.iteration_count == ref.iteration_count == 2
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+# ======================================================================
+# masks: pad_ragged through the last-stage loss
+# ======================================================================
+
+def test_pad_ragged_mask_threads_through_last_stage_loss():
+    rag = _micros(3) + [_micro(mb=5)]
+    ref = _mlp(seed=3)
+    ref.fit(PadToBatchIterator(ListDataSetIterator(list(rag)),
+                               batch_size=8), grad_accumulation=4)
+    tr = ParallelTrainer(_mlp(seed=3), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(list(rag)), grad_accumulation=4,
+           pad_ragged=True)
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_legacy_gpipe_mask_threads_and_matches_accumulation():
+    """The host-GPipe trainer's mask satellite: pad_ragged no longer
+    raises; the padded batch trains bit-exact to single-process
+    accumulation over the identical microbatches (reg-free model — the
+    legacy step normalizes reg by the whole batch, accumulation per
+    micro; both are zero here)."""
+    bds = _micro(mb=30)
+    pb = PadToBatchIterator(ListDataSetIterator([bds]),
+                            batch_size=32).next()
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    tr = ParallelTrainer(_mlp(seed=3), mesh=mesh, strategy="pipeline")
+    tr.fit(pb)
+    x, y = np.asarray(pb.features), np.asarray(pb.labels)
+    lm = np.asarray(pb.labels_mask)
+    micros = [DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8], None,
+                      lm[i * 8:(i + 1) * 8]) for i in range(4)]
+    ref = _mlp(seed=3)
+    ref.fit(ListDataSetIterator(micros), grad_accumulation=4)
+    np.testing.assert_allclose(_flat(tr.publish_view()), _flat(ref),
+                               rtol=0, atol=0)
+
+
+def test_legacy_gpipe_still_rejects_features_masks():
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    tr = ParallelTrainer(_mlp(), mesh=mesh, strategy="pipeline")
+    ds = _micro(mb=8)
+    bad = DataSet(ds.features, ds.labels,
+                  np.ones((8, 1), np.float32), None)
+    with pytest.raises(ValueError, match="features mask"):
+        tr.fit(bad)
+
+
+def test_pp_rejects_features_masks():
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    ds = _micro(mb=8)
+    bad = DataSet(ds.features, ds.labels,
+                  np.ones((8, 1), np.float32), None)
+    with pytest.raises(ValueError, match="features mask"):
+        tr.fit(bad)
+
+
+# ======================================================================
+# fault plane: checkpoint/resume for pp AND the legacy PIPELINE strategy
+# ======================================================================
+
+def test_pp_kill_mid_sharded_save_resume_bitexact(tmp_path):
+    mk = lambda: ParallelTrainer(_mlp(seed=9), mesh_shape=(2, 1, 4),
+                                 strategy=ShardingStrategy.ZERO1_TP_PP)
+    micros = _micros(8)
+    it = lambda: ListDataSetIterator(list(micros))
+    ref = mk()
+    ref.fit(it(), grad_accumulation=4, epochs=2)
+    ref_params = _flat(ref.publish_view())
+
+    d = str(tmp_path / "ck")
+    tr1 = mk()
+    with crash_at_write("sharded/tree_written", nth=2):
+        with pytest.raises(SimulatedCrash):
+            tr1.fit(it(), grad_accumulation=4, epochs=2,
+                    checkpoint_dir=d, checkpoint_every=1)
+    assert ShardedCheckpoint(d).latest_step() is not None
+
+    tr2 = mk()
+    tr2.fit(it(), grad_accumulation=4, epochs=2, checkpoint_dir=d,
+            checkpoint_every=1, resume=True)
+    assert tr2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(_flat(tr2.publish_view()), ref_params,
+                               rtol=1e-12)
+    # restored layouts re-land stacked/pipe-sharded on the mesh
+    assert "pipe" in set().union(*(_axes_used(s)
+                                   for s in _specs(tr2._params["stack"])))
+
+
+def test_legacy_pipeline_kill_mid_save_resume_bitexact(tmp_path):
+    """PR 5's blanket rejection of checkpoint_dir/resume on the PIPELINE
+    strategy is lifted: the GPipe step routes through the sharded store
+    and kill-mid-write resume is bit-exact."""
+    mesh = lambda: make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    mk = lambda: ParallelTrainer(_mlp(seed=5), mesh=mesh(),
+                                 strategy="pipeline")
+    batches = _micros(6, mb=16)
+    it = lambda: ListDataSetIterator(list(batches))
+    ref = mk()
+    ref.fit(it(), epochs=2)
+    ref_params = _flat(ref.publish_view())
+
+    d = str(tmp_path / "ck")
+    tr1 = mk()
+    with crash_at_write("sharded/tree_written", nth=2):
+        with pytest.raises(SimulatedCrash):
+            tr1.fit(it(), epochs=2, checkpoint_dir=d, checkpoint_every=2)
+    assert ShardedCheckpoint(d).latest_step() is not None
+
+    tr2 = mk()
+    tr2.fit(it(), epochs=2, checkpoint_dir=d, checkpoint_every=2,
+            resume=True)
+    assert tr2.iteration_count == ref.iteration_count
+    np.testing.assert_allclose(_flat(tr2.publish_view()), ref_params,
+                               rtol=1e-12)
+
+
+# ======================================================================
+# up-front actionable rejections
+# ======================================================================
+
+def test_indivisible_depth_rejected():
+    with pytest.raises(ValueError, match="does not divide into"):
+        ParallelTrainer(_mlp(depth=6), mesh_shape=(1, 1, 4),
+                        strategy=ShardingStrategy.PP)
+
+
+def test_no_homogeneous_run_rejected():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    model = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="homogeneous"):
+        ParallelTrainer(model, mesh_shape=(1, 1, 4),
+                        strategy=ShardingStrategy.PP)
+
+
+def test_indivisible_microbatch_rows_rejected():
+    """A microbatch whose rows the data axis does not divide is trimmed
+    by the generic SYNC path — but a batch not divisible by the
+    MICROBATCH grouping is the accumulation machinery's actionable
+    error, same as every strategy."""
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    with pytest.raises(ValueError, match="grad_accumulation"):
+        tr.fit(_micro(), grad_accumulation=4)   # single DataSet fit
+
+
+def test_pp_requires_pipe_axis_and_pure_mesh():
+    with pytest.raises(ValueError, match="pipe"):
+        ParallelTrainer(_mlp(), mesh=make_mesh({"data": 8}),
+                        strategy=ShardingStrategy.PP)
+    with pytest.raises(ValueError, match="zero1_tp_pp"):
+        ParallelTrainer(_mlp(), mesh_shape=(2, 1, 4),
+                        strategy=ShardingStrategy.PP)
+
+
+def test_pipe_axis_rejected_for_non_pipeline_strategies():
+    with pytest.raises(ValueError, match="pipe"):
+        ParallelTrainer(_mlp(), mesh_shape=(2, 1, 4),
+                        strategy=ShardingStrategy.ZERO1_TP)
+
+
+def test_graph_models_rejected_for_pp():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    b = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("in")
+    prev = "in"
+    for i in range(4):
+        b.add_layer(f"d{i}", DenseLayer(n_out=16, activation="tanh"), prev)
+        prev = f"d{i}"
+    b.add_layer("out", OutputLayer(n_out=4, loss="mcxent"), prev)
+    b.set_outputs("out")
+    b.set_input_types(InputType.feed_forward(16))
+    g = ComputationGraph(b.build()).init()
+    with pytest.raises(ValueError, match="ComputationGraph"):
+        ParallelTrainer(g, mesh_shape=(1, 1, 4),
+                        strategy=ShardingStrategy.PP)
+
+
+def test_guard_skip_batch_rejected_for_pp():
+    from deeplearning4j_tpu.fault.guard import GuardPolicy, TrainingGuard
+
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    guard = TrainingGuard(policy=GuardPolicy.SKIP_BATCH)
+    with pytest.raises(ValueError, match="skip_batch"):
+        tr.fit(ListDataSetIterator(_micros(8)), grad_accumulation=4,
+               guard=guard)
+
+
+# ======================================================================
+# IR contract: seeded mutations through the probe builders
+# ======================================================================
+
+def test_ir_pp_entries_clean():
+    from deeplearning4j_tpu.analysis.ir import analyze_entry
+    from deeplearning4j_tpu.analysis.ir_probes import pp_entries
+
+    for entry in pp_entries():
+        findings = analyze_entry(entry)
+        assert findings == [], (entry.name, [f.message for f in findings])
+
+
+def test_ir_dropped_stage_constraint_hits():
+    from deeplearning4j_tpu.analysis.ir import analyze_entry
+    from deeplearning4j_tpu.analysis.ir_probes import pp_entry
+
+    entry = pp_entry((2, 1, 4), zero=True, budget_from_plan=True,
+                     budgets={"model": 1 << 20, "other": 1 << 20},
+                     mutate="drop_stage_constraint")
+    rules = {(f.rule, f.snippet.rsplit(":", 1)[-1])
+             for f in analyze_entry(entry)}
+    assert ("ir-implicit-reshard", "constraints") in rules
+
+
+def test_ir_permute_on_data_axis_hits_per_axis_budget():
+    from deeplearning4j_tpu.analysis.ir import analyze_entry
+    from deeplearning4j_tpu.analysis.ir_probes import pp_entry
+
+    entry = pp_entry((2, 1, 4), zero=True,
+                     budgets={"data": 0, "model": 1 << 20,
+                              "other": 1 << 20},
+                     mutate="permute_data_axis")
+    rules = {(f.rule, f.snippet.rsplit(":", 1)[-1])
+             for f in analyze_entry(entry)}
+    assert ("ir-implicit-reshard", "data") in rules
+
+
+def test_permute_axis_classifier():
+    """Unit: source_target_pairs unraveled against the mesh shape —
+    single-axis moves attribute to that axis, multi-axis shuffles to
+    'other', identity legs are ignored."""
+    from deeplearning4j_tpu.analysis.ir import _permute_axis
+
+    items = [("data", 2), ("model", 1), ("pipe", 4)]
+    line = "x = f32[1,2,8] collective-permute(y), " \
+           "source_target_pairs={{0,1},{4,5},{3,0},{7,4}}"
+    assert _permute_axis(line, items) == "pipe"
+    line = "x = f32[1,2,8] collective-permute(y), " \
+           "source_target_pairs={{0,4},{1,5}}"
+    assert _permute_axis(line, items) == "data"
+    line = "x = f32[1,2,8] collective-permute(y), " \
+           "source_target_pairs={{0,0},{1,4}}"
+    assert _permute_axis(line, items) == "other"
+    assert _permute_axis("no pairs here", items) is None
+
+
+# ======================================================================
+# eval plane + publish
+# ======================================================================
+
+def test_score_and_evaluate_on_published_view():
+    tr = ParallelTrainer(_mlp(), mesh_shape=(2, 2, 2),
+                         strategy=ShardingStrategy.ZERO1_TP_PP)
+    tr.fit(ListDataSetIterator(_micros(4)), grad_accumulation=4)
+    ds = _micro(mb=16)
+    s = tr.score(ds)
+    assert np.isfinite(s)
+    ev = tr.evaluate(ds)
+    assert 0.0 <= ev.accuracy() <= 1.0
+    # published per-layer view matches the model structure
+    model = tr.publish_view()
+    assert len(model.params) == len(model.layers)
