@@ -395,6 +395,21 @@ def main():
     except Exception as e:
         extras["Serving-latency"] = f"error: {type(e).__name__}"
     try:
+        # decode plane (ISSUE 16): closed-loop generation clients
+        # through the /generate data plane, continuous (token-level
+        # admission) vs static (request-level) batching in alternating
+        # paired windows — tokens/s per arm, the median paired ratio
+        # (gate > 1), p50/p99 request latency, a zero-failed-requests
+        # hot-swap under generation load, and one XLA compile per
+        # (model, phase, bucket) across the whole run
+        from deeplearning4j_tpu.serving.decode.bench import \
+            run_decode_bench
+        extras["Serving-decode-tokens-per-s"] = run_decode_bench(
+            n_clients=8, requests_per_client=3, pairs=3)
+    except Exception as e:
+        extras["Serving-decode-tokens-per-s"] = \
+            f"error: {type(e).__name__}"
+    try:
         # pipeline parallelism (ISSUE 15): the transformer LM trained
         # mesh-native 1F1B vs host-GPipe vs ZERO1×TP in alternating
         # paired windows — tokens/s per arm, the paired

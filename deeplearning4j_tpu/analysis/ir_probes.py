@@ -30,7 +30,8 @@ from .ir import IrEntry
 __all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
            "parallel_entries", "zero_accum_entry", "mesh2d_entries",
            "mesh2d_zero1_tp_entry", "pp_entry", "pp_entries",
-           "serving_entries", "virtual_mesh"]
+           "serving_entries", "decode_entry", "decode_entries",
+           "virtual_mesh"]
 
 
 def virtual_mesh():
@@ -570,6 +571,87 @@ def serving_entries() -> List[IrEntry]:
             for name, bucket, co in reg.aot_executables()]
 
 
+def _decode_build(seed: int = 0):
+    """Tiny generate-capable LM (vocab=16, width=8, 1 block) registered
+    into a fresh registry, plus the paged decode engine over it — small
+    enough that tracing both decode-plane steps is milliseconds."""
+    from .. import (Adam, EmbeddingSequenceLayer, InputType,
+                    MultiLayerNetwork, NeuralNetConfiguration,
+                    RnnOutputLayer, TransformerBlock)
+    from ..serving.decode.engine import DecodeEngine
+    from ..serving.registry import ModelRegistry
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=16, n_out=8))
+            .layer(TransformerBlock(n_heads=2))
+            .layer(RnnOutputLayer(n_out=16, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, 16)).build())
+    reg = ModelRegistry()
+    reg.register("ir-gen", MultiLayerNetwork(conf).init(), buckets=(1,))
+    eng = DecodeEngine(reg, "ir-gen", block_len=4, decode_buckets=(1, 2))
+    return eng, reg.get("ir-gen")
+
+
+def decode_entry(phase: str = "tick",
+                 mutate: Optional[str] = None) -> IrEntry:
+    """One decode-plane jit entry (`phase` in prefill|tick), donation and
+    byte budget declared: the cache pytree (arg 1) is donated and must
+    alias the output arena bit-for-bit; a single-device step declares 0
+    collective payload bytes.
+
+    Mutations (each must trip exactly one IR rule):
+
+      mutate="donate_tokens"  the int32 token ids are donated TOO — they
+        can alias nothing in the (f32/int8 cache, f32 logits) outputs,
+        so the lowering/XLA must drop that donation
+        -> ir-ineffective-donation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.decode.cache import make_cache
+    from ..serving.decode.engine import build_decode_fn, build_prefill_fn
+
+    eng, v = _decode_build()
+    spec = eng.spec
+    if mutate is None:
+        donate = (1,)
+    elif mutate == "donate_tokens":
+        donate = (1, 2)
+    else:
+        raise ValueError(f"unknown mutation {mutate!r}")
+    w = spec.table_width
+    if phase == "prefill":
+        fn = build_prefill_fn(v.model, v.snapshot, spec)
+        args = (v.snapshot.data, make_cache(spec),
+                jnp.zeros((1, 8), jnp.int32), jnp.ones((1,), jnp.int32),
+                jnp.zeros((1, w), jnp.int32))
+    elif phase == "tick":
+        fn = build_decode_fn(v.model, v.snapshot, spec)
+        args = (v.snapshot.data, make_cache(spec),
+                jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+                jnp.zeros((2, w), jnp.int32))
+    else:
+        raise ValueError(f"unknown decode phase {phase!r}")
+    from ..telemetry.compile_watch import watch_compiles
+    jitted = watch_compiles(
+        jax.jit(fn, donate_argnums=donate),
+        f"analysis/ir_probe:decode_{phase}").__wrapped__
+    return IrEntry(f"serving/decode_{phase}", "serving/decode/engine.py",
+                   fn=jitted, args=args,
+                   declared_bytes=0, check_bytes=True)
+
+
+def decode_entries() -> List[IrEntry]:
+    """The generation plane's two compiled signatures (ISSUE 16): the
+    batch-1 prompt prefill and the batched decode tick, audited for
+    donation aliasing (the arena must update in place, never copy) and
+    the zero-collective byte budget of a single-device step."""
+    return [decode_entry("prefill"), decode_entry("tick")]
+
+
 def build_entries() -> List[IrEntry]:
     """The full IR roster, in deterministic order. Every entry family the
     package registers through watch_compiles/record_aot is represented;
@@ -583,4 +665,5 @@ def build_entries() -> List[IrEntry]:
     entries += pp_entries()
     entries += mesh2d_entries()
     entries += serving_entries()
+    entries += decode_entries()
     return entries

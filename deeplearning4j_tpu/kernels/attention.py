@@ -38,17 +38,38 @@ _NEG_INF = float("-inf")
 
 
 def attention_reference(q, k, v, causal: bool = False,
-                        sm_scale: Optional[float] = None):
-    """Plain softmax attention oracle. q: [B, T, D], k/v: [B, S, D]."""
+                        sm_scale: Optional[float] = None,
+                        q_positions=None, kv_length=None):
+    """Plain softmax attention oracle. q: [B, T, D], k/v: [B, S, D].
+
+    Decode extension (serving/decode): queries may sit at arbitrary
+    offsets inside a LONGER key cache, so a square causal mask is not
+    enough. `q_positions` [B, T] gives each query row's absolute key
+    index (causal then means key j attends iff j <= q_positions[b, t] —
+    a causal OFFSET, defaulting to the classic arange diagonal), and
+    `kv_length` ([B] or scalar) the per-row count of valid cache slots:
+    keys at j >= kv_length[b] (block-table padding, slots not yet
+    written) get no attention weight. A row whose mask admits zero keys
+    produces NaN — callers guarantee kv_length >= 1 for live rows (the
+    decode plane parks padded batch slots at position 0 of a reserved
+    block, so every row keeps one valid key)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     logits = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * sm_scale
+    T, S = logits.shape[-2], logits.shape[-1]
+    ki = jnp.arange(S)
     if causal:
-        T, S = logits.shape[-2], logits.shape[-1]
-        qi = jnp.arange(T)[:, None]
-        ki = jnp.arange(S)[None, :]
-        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+        if q_positions is None:
+            qi = jnp.arange(T)[None, :]            # classic diagonal
+        else:
+            qi = jnp.asarray(q_positions)           # [B, T] offsets
+        logits = jnp.where(ki[None, None, :] <= qi[:, :, None],
+                           logits, _NEG_INF)
+    if kv_length is not None:
+        lengths = jnp.reshape(jnp.asarray(kv_length, jnp.int32), (-1,))
+        logits = jnp.where(ki[None, None, :] < lengths[:, None, None],
+                           logits, _NEG_INF)
     w = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", w, v.astype(jnp.float32)).astype(q.dtype)
 

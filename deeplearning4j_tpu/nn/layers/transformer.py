@@ -195,6 +195,50 @@ class TransformerBlock(LayerConf):
         x = x + f @ params["W_ffn_out"] + params["b_ffn_out"]
         return x, state
 
+    # -- decode mode (KV-cache generation, serving/decode) -----------------
+    # The autoregressive serving plane splits the block into three traced
+    # pieces so the PAGED cache scatter/gather can happen between them
+    # (the layer owns the math, the decode engine owns the block tables):
+    #   q, k, v = blk.decode_qkv(p, x)        # LN1 + projections
+    #   <engine scatters k/v into its arena, gathers the cache view>
+    #   a = blk.decode_attend(q, k_all, v_all, positions, lengths)
+    #   y = blk.decode_finish(p, x, a)        # out-proj + FFN residuals
+    # Chaining the three over a full causal prompt (k_all = k, v_all = v,
+    # positions = arange) is mathematically `apply` — the prefill+decode
+    # equivalence suite asserts it against the full-sequence forward.
+    def decode_qkv(self, params, x):
+        """LN1 + QKV projection: x [B, T, D] -> q/k/v each [B, T, H, Dh]."""
+        b, t, d = x.shape
+        hd = d // self.n_heads
+        h1 = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        split = lambda z: z.reshape(b, t, self.n_heads, hd)
+        return (split(h1 @ params["W_q"] + params["b_q"]),
+                split(h1 @ params["W_k"] + params["b_k"]),
+                split(h1 @ params["W_v"] + params["b_v"]))
+
+    def decode_attend(self, q, k_all, v_all, positions, lengths):
+        """Attention over a cached-key view: q [B, Tn, H, Dh] (the Tn
+        newest tokens, absolute key indices `positions` [B, Tn]),
+        k_all/v_all [B, S, H, Dh] the full cache view (new keys already
+        merged in), `lengths` [B] valid cache slots per row. Causal
+        offsets + per-row valid length ride the extended
+        `attention_reference` mask."""
+        from ...kernels.attention import attention_reference
+
+        fn = lambda qh, kh, vh: attention_reference(
+            qh, kh, vh, self.causal, q_positions=positions,
+            kv_length=lengths)
+        return jax.vmap(fn, in_axes=(2, 2, 2), out_axes=2)(q, k_all, v_all)
+
+    def decode_finish(self, params, x, attn):
+        """Post-attention half: out-projection residual, then the FFN
+        residual. attn [B, Tn, H, Dh] -> [B, Tn, D]."""
+        b, t, d = x.shape
+        x = x + attn.reshape(b, t, d) @ params["W_o"] + params["b_o"]
+        h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        f = self._act(h2 @ params["W_ffn_in"] + params["b_ffn_in"])
+        return x + f @ params["W_ffn_out"] + params["b_ffn_out"]
+
     def __post_init__(self):
         # FFN nonlinearity defaults to gelu (GPT convention), not the
         # base "identity"
@@ -257,3 +301,13 @@ class EmbeddingSequenceLayer(LayerConf):
         z = jnp.take(params["W"], idx, axis=0)
         t = z.shape[1]
         return z + params["P"][:t][None], state
+
+    def decode_embed(self, params, idx, positions):
+        """Decode-mode lookup: token + position embedding at ARBITRARY
+        absolute positions (a decode step embeds one token at position
+        `t`, not a [0..T) prefix slice). idx/positions [B, T] ->
+        [B, T, n_out]. `positions` must stay below the positional table
+        length — the table bounds the decode plane's context window."""
+        z = jnp.take(params["W"], idx.astype(jnp.int32), axis=0)
+        return z + jnp.take(params["P"], positions.astype(jnp.int32),
+                            axis=0)

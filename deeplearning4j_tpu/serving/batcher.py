@@ -33,7 +33,71 @@ import numpy as np
 from ..datasets.pipeline import pad_rows
 from .registry import ServingError
 
-__all__ = ["DynamicBatcher", "BatcherClosedError"]
+__all__ = ["DynamicBatcher", "BatcherClosedError", "FlushEma"]
+
+
+class FlushEma:
+    """Per-bucket EMA of flush wall seconds, shared by the stateless
+    DynamicBatcher and the decode plane's GenerationScheduler (which uses
+    it to pick the decode-tick bucket).
+
+    `estimate` extrapolates unsampled buckets by LINEAR scaling —
+    deliberately pessimistic (assumes zero batching amortization), so an
+    unsampled small bucket looks exactly break-even and gets tried, then
+    its real cost takes over. Extrapolation prefers the smallest SAMPLED
+    bucket ABOVE the target (scaling down from a larger batch), floored
+    by any measured smaller bucket — the old nearest-by-absolute-distance
+    pick could scale UP from a tiny bucket even when a much more
+    representative larger one had been measured (|1-8| < |32-8|),
+    estimating an 8-wide flush at 8x a 1-wide one and ignoring the fixed
+    per-flush dispatch cost entirely. The floor keeps estimates monotone
+    in the bucket size: a bigger batch never flushes faster than a
+    measured smaller one in the same executable family."""
+
+    __slots__ = ("_ema",)
+
+    def __init__(self):
+        self._ema: dict = {}   # bucket -> EMA flush seconds
+
+    def observe(self, bucket: int, dt: float):
+        prev = self._ema.get(bucket)
+        self._ema[bucket] = dt if prev is None else 0.5 * prev + 0.5 * dt
+
+    def estimate(self, bucket: int) -> Optional[float]:
+        t = self._ema.get(bucket)
+        if t is not None:
+            return t
+        if not self._ema:
+            return None
+        larger = [b for b in self._ema if b > bucket]
+        if larger:
+            b0 = min(larger)
+            est = self._ema[b0] * bucket / b0
+            smaller = [b for b in self._ema if b < bucket]
+            if smaller:
+                est = max(est, self._ema[max(smaller)])
+            return est
+        b0 = max(self._ema)
+        return self._ema[b0] * bucket / b0
+
+    def pick_rows(self, avail: int, buckets: Tuple[int, ...],
+                  cap: int) -> int:
+        """Row budget for a flush of `avail` queued rows against compiled
+        `buckets`: everything padded up to the next bucket, or only the
+        largest FULL bucket's worth — whichever yields more rows/second
+        under the EMAs (Clipper-style adaptive batch sizing)."""
+        cap = min(cap, buckets[-1])
+        if avail >= cap:
+            return cap
+        up = next((b for b in buckets if b >= avail), buckets[-1])
+        full = [b for b in buckets if b <= avail]
+        if not full or full[-1] == up:
+            return avail
+        fb = max(full)
+        t_up, t_fb = self.estimate(up), self.estimate(fb)
+        if not t_up or not t_fb:
+            return avail
+        return avail if avail / t_up >= fb / t_fb else fb
 
 # one reusable completion Event per client thread: submit() is blocking,
 # so a thread has at most one pending request, and recycling the pthread
@@ -88,7 +152,7 @@ class DynamicBatcher:
         self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_us) / 1e6)
         self.buckets = tuple(sorted(buckets)) if buckets else None
-        self._flush_ema: dict = {}   # bucket -> EMA flush seconds
+        self._flush_ema = FlushEma()
         self.name = name
         # enqueue is lock-free: deque.append is atomic under the GIL and
         # the worker is the only consumer, so clients pay one append + one
@@ -182,20 +246,6 @@ class DynamicBatcher:
             p.event.set()
 
     # -- worker side -----------------------------------------------------
-    def _est_flush_s(self, bucket: int) -> Optional[float]:
-        """EMA flush seconds for `bucket`; unsampled buckets are estimated
-        by LINEAR scaling from the nearest sampled one — deliberately
-        pessimistic (assumes zero batching amortization), so an unsampled
-        small bucket looks exactly break-even and gets tried, then its
-        real cost takes over."""
-        t = self._flush_ema.get(bucket)
-        if t is not None:
-            return t
-        if not self._flush_ema:
-            return None
-        b0 = min(self._flush_ema, key=lambda b: abs(b - bucket))
-        return self._flush_ema[b0] * bucket / b0
-
     def _flush_budget(self, avail: int) -> int:
         """Row budget for a deadline flush.
 
@@ -204,28 +254,14 @@ class DynamicBatcher:
         flushing one full 8 and leaving 10 queued (their original
         enqueue-time deadlines still bind) keeps executable utilization
         high. Which choice wins depends on the measured per-bucket flush
-        cost, so the batcher picks adaptively: flush all `avail` rows
-        padded up to the next bucket, or only the largest full bucket's
-        worth — whichever yields more rows/second under the flush-time
-        EMAs (Clipper-style adaptive batch sizing)."""
+        cost, so the batcher delegates to the FlushEma's adaptive pick.
+        A flush can never exceed the largest compiled bucket — a
+        max_batch configured above it must not poison whole batches with
+        bucket_for() failures at flush time."""
         if self.buckets is None:
             return self.max_batch
-        # a flush can never exceed the largest compiled bucket — a
-        # max_batch configured above it must not poison whole batches
-        # with bucket_for() failures at flush time
-        cap = min(self.max_batch, self.buckets[-1])
-        if avail >= cap:
-            return cap
-        up = next((b for b in self.buckets if b >= avail),
-                  self.buckets[-1])
-        full = [b for b in self.buckets if b <= avail]
-        if not full or full[-1] == up:
-            return avail
-        fb = max(full)
-        t_up, t_fb = self._est_flush_s(up), self._est_flush_s(fb)
-        if not t_up or not t_fb:
-            return avail
-        return avail if avail / t_up >= fb / t_fb else fb
+        return self._flush_ema.pick_rows(avail, self.buckets,
+                                         self.max_batch)
 
     def _queued_rows(self) -> int:
         # worker-side snapshot; clients only append, so this can lag but
@@ -288,9 +324,7 @@ class DynamicBatcher:
             t0 = time.perf_counter()
             out, version = self._runner(pad_rows(x, bucket - rows), bucket)
             dt = time.perf_counter() - t0
-            prev = self._flush_ema.get(bucket)   # worker-thread-only state
-            self._flush_ema[bucket] = dt if prev is None \
-                else 0.5 * prev + 0.5 * dt
+            self._flush_ema.observe(bucket, dt)  # worker-thread-only state
             if self._batch_size_h is not None:
                 self._batch_size_h.observe(rows, model=self.name)
                 self._rows_c.inc(rows, model=self.name, kind="real")

@@ -1,0 +1,179 @@
+"""Paged KV-cache arena + block-pool allocator (vLLM/PagedAttention,
+Kwon et al., SOSP 2023, applied to our layer stack).
+
+One preallocated arena per servable holds EVERY concurrent sequence's
+keys and values:
+
+    arena  [num_blocks, block_len, 2*L, H, Dh]
+
+where channel ``2l`` is layer l's keys and ``2l+1`` its values. A
+sequence owns an ordered list of block ids (its BLOCK TABLE); cache slot
+``t`` of a sequence lives at ``(table[t // block_len], t % block_len)``.
+The compiled steps scatter new K/V by block index and gather a
+sequence's whole cache view through its table — HBM is shared at block
+granularity, so thousands of sequences with wildly different lengths
+pack the arena with at most ``block_len - 1`` wasted slots each, instead
+of every sequence reserving a max-context rectangle.
+
+Block 0 is RESERVED (the "trash" block): padded batch slots and
+overflow prompt positions write there and their reads are always masked
+by the per-row valid length, so the compiled step needs no branches for
+dead rows. Allocation never hands out block 0.
+
+int8 KV (``kv_dtype="int8"``): the arena stores int8 plus a per-slot
+scale arena ``[num_blocks, block_len, 2*L]`` — `serving/quantize.py`'s
+per-tensor symmetric scheme (scale = absmax / 127) applied per cached
+(position, layer, K|V) vector, quantized at scatter time and
+dequantized inside the gather. Halves-of-halves memory for the cache at
+~1e-2-level logit drift; the equivalence/bit-exactness contracts are
+asserted on the fp32 cache only.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+__all__ = ["KvCacheSpec", "BlockPool", "OutOfBlocksError"]
+
+
+class OutOfBlocksError(RuntimeError):
+    """Allocation against an exhausted pool — the scheduler's cue to
+    evict (preempt) a running sequence."""
+
+
+@dataclass(frozen=True)
+class KvCacheSpec:
+    """Static shape contract of one servable's paged cache. Part of the
+    compiled signature: every decode executable is specialized to it."""
+
+    n_layers: int          # transformer blocks L (arena channels = 2L)
+    n_heads: int
+    d_head: int
+    block_len: int         # cache slots per block
+    num_blocks: int        # arena height, INCLUDING the reserved block 0
+    max_context: int       # hard cap (the positional table length)
+    kv_dtype: str = "fp32"   # "fp32" | "int8"
+
+    def __post_init__(self):
+        if self.kv_dtype not in ("fp32", "int8"):
+            raise ValueError(f"kv_dtype must be fp32|int8, got "
+                             f"{self.kv_dtype!r}")
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        if self.block_len < 1 or self.max_context < 1:
+            raise ValueError("block_len and max_context must be >= 1")
+
+    @property
+    def table_width(self) -> int:
+        """Block-table columns per sequence: enough for max_context."""
+        return -(-self.max_context // self.block_len)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of `n_tokens` cache slots occupies."""
+        return -(-max(1, n_tokens) // self.block_len)
+
+    def arena_nbytes(self) -> int:
+        slots = self.num_blocks * self.block_len * 2 * self.n_layers
+        per = self.n_heads * self.d_head
+        if self.kv_dtype == "int8":
+            return slots * per + slots * 4      # int8 data + f32 scales
+        return slots * per * 4
+
+
+def make_cache(spec: KvCacheSpec) -> Dict[str, jnp.ndarray]:
+    """Fresh zeroed cache pytree — ONE donated argument of the compiled
+    steps. fp32: {"kv": arena}; int8 adds the per-slot scale arena."""
+    shape = (spec.num_blocks, spec.block_len, 2 * spec.n_layers,
+             spec.n_heads, spec.d_head)
+    if spec.kv_dtype == "int8":
+        return {"kv": jnp.zeros(shape, jnp.int8),
+                "scale": jnp.ones(shape[:3], jnp.float32)}
+    return {"kv": jnp.zeros(shape, jnp.float32)}
+
+
+def pack_kv(spec: KvCacheSpec, x):
+    """Prepare K or V slices [..., H, Dh] for a cache scatter. Returns
+    (values, scales_or_None): int8 quantizes per leading-index vector
+    (per-tensor symmetric over the trailing [H, Dh])."""
+    if spec.kv_dtype != "int8":
+        return x, None
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def unpack_kv(spec: KvCacheSpec, q, scale):
+    """Dequantize a gathered cache view (inverse of `pack_kv`)."""
+    if spec.kv_dtype != "int8":
+        return q
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+class BlockPool:
+    """Host-side free-list allocator over the arena's block ids.
+
+    The pool owns the DEVICE cache arrays too (`cache` — replaced after
+    every compiled step with the donated step's output), so eviction,
+    reuse and accounting share one lock. Thread-safe; the scheduler
+    worker is the only writer of `cache`."""
+
+    def __init__(self, spec: KvCacheSpec, metrics=None, name: str = "model"):
+        self.spec = spec
+        self.name = name
+        self.cache = make_cache(spec)
+        self._lock = threading.Lock()
+        # LIFO free list: a just-freed (hot, possibly still resident)
+        # block is reused first — also what makes the reuse-after-evict
+        # bit-exactness test deterministic about WHICH blocks recycle
+        self._free: List[int] = list(range(spec.num_blocks - 1, 0, -1))
+        self._blocks_g = None
+        if metrics is not None:
+            self._blocks_g = metrics.gauge(
+                "dl4j_decode_kv_blocks",
+                "paged KV arena blocks by state (block 0 reserved)",
+                labels=("model", "state"))
+            self._report()
+
+    def _report(self):
+        if self._blocks_g is not None:
+            free = len(self._free)
+            self._blocks_g.set(free, model=self.name, state="free")
+            self._blocks_g.set(self.spec.usable_blocks - free,
+                               model=self.name, state="used")
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_blocks(self) -> int:
+        return self.spec.usable_blocks - self.free_blocks()
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` blocks or raise OutOfBlocksError (all-or-nothing: a
+        partial grab under pressure would deadlock two growing
+        sequences against each other)."""
+        with self._lock:
+            if n > len(self._free):
+                raise OutOfBlocksError(
+                    f"{self.name}: need {n} KV blocks, {len(self._free)} "
+                    f"free of {self.spec.usable_blocks}")
+            taken = [self._free.pop() for _ in range(n)]
+            self._report()
+            return taken
+
+    def release(self, blocks: List[int]):
+        with self._lock:
+            for b in blocks:
+                if not 0 < b < self.spec.num_blocks:
+                    raise ValueError(f"bad KV block id {b}")
+            self._free.extend(reversed(blocks))
+            self._report()

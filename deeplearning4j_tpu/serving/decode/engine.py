@@ -1,0 +1,340 @@
+"""The KV-cache generation forward: AOT-compiled prefill + decode-tick
+steps over the transformer stack's decode mode.
+
+Two compiled signatures per servable, both AOT-lowered through the
+registry's shared executable cache (`ModelRegistry.compile_cached`, keys
+namespaced ("decode", sig, phase, bucket)) so the server-lifetime
+invariant of the stateless plane extends to generation: ONE XLA compile
+per (model, bucket, phase), no cold compile on any request path, and a
+same-architecture hot-swap reuses every decode executable.
+
+  prefill(data, cache, tokens [1, Tp], lengths [1], tables [1, W])
+      -> (cache', next_logits [1, V])
+    The whole (right-padded) prompt runs as one causal forward — the
+    standard full-sequence math, row-masked by `lengths` — while every
+    layer's K/V projections scatter into the paged arena through the
+    sequence's block table. Prompt attention uses the LOCAL (exact)
+    projections, so int8 cache quantization only affects later ticks.
+
+  decode(data, cache, tokens [B], positions [B], tables [B, W])
+      -> (cache', logits [B, V])
+    One token per row: embed at its absolute position, scatter its K/V
+    into the arena, gather the row's whole cache view through its block
+    table, attend with causal offsets + per-row valid length
+    (`kernels.attention` kv_length path), project logits.
+
+The cache pytree is DONATED: the arena updates in place on device, so a
+tick costs one [B,*] pass plus the table gathers, never an arena copy.
+Rows are independent throughout (no cross-row reductions), which is
+what makes token-granularity join/leave bit-exact for the rows that
+stay — the continuous-batching isolation contract the tests assert.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...telemetry.compile_watch import watch_compiles
+from ..registry import ServingError, _abstract_sig
+from .cache import BlockPool, KvCacheSpec, make_cache, pack_kv, unpack_kv
+
+__all__ = ["DecodeEngine", "build_prefill_fn", "build_decode_fn",
+           "split_decode_layers"]
+
+
+def split_decode_layers(model):
+    """(embedding, [blocks...], head) of a generate-capable stack, or
+    ServingError. The decode plane supports exactly the GPT shape:
+    EmbeddingSequenceLayer -> TransformerBlock* -> an output layer with
+    `preout` (logits before the softmax activation)."""
+    from ...nn.layers.transformer import (EmbeddingSequenceLayer,
+                                          TransformerBlock)
+
+    layers = getattr(model, "layers", None)
+    if not layers or len(layers) < 3 \
+            or not isinstance(layers[0], EmbeddingSequenceLayer) \
+            or not all(isinstance(b, TransformerBlock)
+                       for b in layers[1:-1]) \
+            or not hasattr(layers[-1], "preout"):
+        raise ServingError(
+            "generation needs an EmbeddingSequenceLayer -> "
+            "TransformerBlock* -> output-layer stack; got "
+            f"{[type(l).__name__ for l in (layers or [])]}")
+    if getattr(model.conf, "preprocessors", None):
+        raise ServingError(
+            "generation does not support input preprocessors between "
+            "decode layers")
+    return layers[0], list(layers[1:-1]), layers[-1]
+
+
+def _cache_arg_specs(spec: KvCacheSpec):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), make_cache(spec))
+
+
+def _scatter(spec, kv, sc, values, blk, off, channel):
+    """Write K or V `values` (leading index shape == blk/off) into the
+    arena at (blk, off, channel), quantizing for int8 caches."""
+    vals, scales = pack_kv(spec, values)
+    kv = kv.at[blk, off, channel].set(vals)
+    if scales is not None:
+        sc = sc.at[blk, off, channel].set(scales)
+    return kv, sc
+
+
+def _gather(spec, kv, sc, tables, channel):
+    """Sequence-major cache view [B, W*block_len, H, Dh] of one channel,
+    dequantized: every row reads its own blocks through its table (dead
+    table slots point at the trash block; always length-masked)."""
+    view = kv[:, :, channel][tables]            # [B, W, bl, H, Dh]
+    b = tables.shape[0]
+    view = view.reshape(b, -1, spec.n_heads, spec.d_head)
+    if sc is None:
+        return view
+    scale = sc[:, :, channel][tables].reshape(b, -1)
+    return unpack_kv(spec, view, scale)
+
+
+def _repack(cache, kv, sc):
+    return {"kv": kv, "scale": sc} if "scale" in cache else {"kv": kv}
+
+
+def build_prefill_fn(model, snapshot, spec: KvCacheSpec):
+    """Pure prefill step (see module docstring). Closed over the layer
+    configs and the snapshot's dequantization structure only — the flat
+    `data` tuple stays a runtime argument, so re-quantized checkpoints
+    share the executable (the stateless plane's convention)."""
+    emb, blocks, head = split_decode_layers(model)
+
+    def prefill(data, cache, tokens, lengths, tables):
+        params = snapshot.rebuild(data)
+        b, tp = tokens.shape
+        pos = jnp.broadcast_to(jnp.arange(tp, dtype=jnp.int32), (b, tp))
+        x = emb.decode_embed(params[0], tokens, pos)
+        kv, sc = cache["kv"], cache.get("scale")
+        tidx = jnp.arange(tp, dtype=jnp.int32)
+        # right-padded prompt slots scatter too (their K/V derive
+        # deterministically from the pad token, and table slots past the
+        # allocation point at the trash block), so a reused block is
+        # overwritten wholesale — reuse is bit-identical to fresh
+        blk = tables[:, tidx // spec.block_len]
+        off = jnp.broadcast_to(tidx % spec.block_len, (b, tp))
+        for i, layer in enumerate(blocks):
+            q, k, v = layer.decode_qkv(params[1 + i], x)
+            kv, sc = _scatter(spec, kv, sc, k, blk, off, 2 * i)
+            kv, sc = _scatter(spec, kv, sc, v, blk, off, 2 * i + 1)
+            a = layer.decode_attend(q, k, v, pos, lengths)
+            x = layer.decode_finish(params[1 + i], x, a)
+        logits = head.preout(params[-1], {}, x)          # [B, Tp, V]
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        return _repack(cache, kv, sc), last.astype(jnp.float32)
+
+    return prefill
+
+
+def build_decode_fn(model, snapshot, spec: KvCacheSpec):
+    """Pure one-token decode tick (see module docstring)."""
+    emb, blocks, head = split_decode_layers(model)
+
+    def decode(data, cache, tokens, positions, tables):
+        params = snapshot.rebuild(data)
+        b = tokens.shape[0]
+        lengths = positions + 1          # pad rows: position 0 -> length 1
+        x = emb.decode_embed(params[0], tokens[:, None], positions[:, None])
+        kv, sc = cache["kv"], cache.get("scale")
+        blk = tables[jnp.arange(b), positions // spec.block_len]
+        off = positions % spec.block_len
+        for i, layer in enumerate(blocks):
+            q, k, v = layer.decode_qkv(params[1 + i], x)
+            kv, sc = _scatter(spec, kv, sc, k[:, 0], blk, off, 2 * i)
+            kv, sc = _scatter(spec, kv, sc, v[:, 0], blk, off, 2 * i + 1)
+            k_all = _gather(spec, kv, sc, tables, 2 * i)
+            v_all = _gather(spec, kv, sc, tables, 2 * i + 1)
+            a = layer.decode_attend(q, k_all, v_all, positions[:, None],
+                                    lengths)
+            x = layer.decode_finish(params[1 + i], x, a)
+        logits = head.preout(params[-1], {}, x)[:, 0]
+        return _repack(cache, kv, sc), logits.astype(jnp.float32)
+
+    return decode
+
+
+def _pow2_buckets(lo: int, hi: int) -> Tuple[int, ...]:
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(sorted(set(out)))
+
+
+class DecodeEngine:
+    """Compiled-step frontend for one servable's generation plane.
+
+    Owns the static cache geometry (`spec`) and the bucket ladders; the
+    executables live in the registry's per-model cache so swaps and the
+    compile accounting behave exactly like the stateless runners. The
+    scheduler calls `run_prefill` / `run_tick` with host data; both only
+    ever invoke finished executables."""
+
+    def __init__(self, registry, name: str, *, block_len: int = 16,
+                 num_blocks: Optional[int] = None, kv_dtype: str = "fp32",
+                 decode_buckets: Sequence[int] = (1, 2, 4, 8),
+                 prompt_buckets: Optional[Sequence[int]] = None):
+        self.registry = registry
+        self.name = name
+        v = registry.get(name)
+        if v.model is None:
+            raise ServingError(
+                f"{name}: servable holds no live model object — "
+                "generation needs the layer stack")
+        emb, blocks, head = split_decode_layers(v.model)
+        d = emb.n_out
+        heads = blocks[0].n_heads
+        if any(blk.n_heads != heads for blk in blocks):
+            raise ServingError(f"{name}: blocks disagree on n_heads")
+        max_context = int(np.asarray(v.model.params[0]["P"]).shape[0])
+        self.decode_buckets = tuple(sorted(int(b) for b in decode_buckets))
+        if num_blocks is None:
+            # default: full residency for a max-bucket batch of
+            # max-context sequences, plus the reserved trash block
+            per_seq = -(-max_context // block_len)
+            num_blocks = 1 + per_seq * self.decode_buckets[-1]
+        self.spec = KvCacheSpec(
+            n_layers=len(blocks), n_heads=heads, d_head=d // heads,
+            block_len=int(block_len), num_blocks=int(num_blocks),
+            max_context=max_context, kv_dtype=kv_dtype)
+        self.prompt_buckets = (tuple(sorted(int(b) for b in prompt_buckets))
+                               if prompt_buckets else
+                               _pow2_buckets(min(8, max_context),
+                                             max_context))
+        if self.prompt_buckets[-1] > max_context:
+            raise ServingError(
+                f"{name}: prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"the positional table ({max_context})")
+
+    # -- geometry --------------------------------------------------------
+    @property
+    def max_context(self) -> int:
+        return self.spec.max_context
+
+    def new_pool(self, metrics=None) -> BlockPool:
+        return BlockPool(self.spec, metrics=metrics, name=self.name)
+
+    def prompt_bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise ServingError(
+            f"{self.name}: prompt of {n} tokens exceeds the context "
+            f"window {self.max_context}")
+
+    def decode_bucket_for(self, rows: int) -> int:
+        for b in self.decode_buckets:
+            if rows <= b:
+                return b
+        raise ServingError(
+            f"{self.name}: decode batch {rows} exceeds bucket "
+            f"{self.decode_buckets[-1]}")
+
+    # -- AOT executables -------------------------------------------------
+    def _check_version(self, v):
+        # a hot-swap to a different architecture would silently change
+        # the cache geometry under live sequences — fail loudly instead
+        emb, blocks, _ = split_decode_layers(v.model)
+        if (len(blocks) != self.spec.n_layers
+                or blocks[0].n_heads != self.spec.n_heads
+                or emb.n_out != self.spec.n_heads * self.spec.d_head):
+            raise ServingError(
+                f"{self.name}: swapped architecture no longer matches the "
+                "generation cache geometry; re-enable generation")
+        return v
+
+    def prefill_exec(self, v, t_bucket: int):
+        sig = _abstract_sig(v.snapshot, v.state, v.precision)
+        spec = self.spec
+
+        def build():
+            prefill_step = watch_compiles(
+                jax.jit(build_prefill_fn(v.model, v.snapshot, spec),
+                        donate_argnums=(1,)),
+                f"serving/decode:{self.name}/prefill-t{t_bucket}").__wrapped__
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+            return prefill_step.lower(
+                v.snapshot.data, _cache_arg_specs(spec),
+                i32(1, t_bucket), i32(1), i32(1, spec.table_width)
+            ).compile()
+
+        return self.registry.compile_cached(
+            self.name, ("decode", sig, "prefill", t_bucket), build,
+            f"prefill-t{t_bucket}")
+
+    def decode_exec(self, v, bucket: int):
+        sig = _abstract_sig(v.snapshot, v.state, v.precision)
+        spec = self.spec
+
+        def build():
+            decode_step = watch_compiles(
+                jax.jit(build_decode_fn(v.model, v.snapshot, spec),
+                        donate_argnums=(1,)),
+                f"serving/decode:{self.name}/tick-b{bucket}").__wrapped__
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+            return decode_step.lower(
+                v.snapshot.data, _cache_arg_specs(spec),
+                i32(bucket), i32(bucket), i32(bucket, spec.table_width)
+            ).compile()
+
+        return self.registry.compile_cached(
+            self.name, ("decode", sig, "tick", bucket), build,
+            f"decode-b{bucket}")
+
+    # -- host-facing phases ----------------------------------------------
+    def _pad_table(self, table: Sequence[int]) -> List[int]:
+        w = self.spec.table_width
+        if len(table) > w:
+            raise ServingError(f"{self.name}: block table of {len(table)} "
+                               f"exceeds width {w}")
+        return list(table) + [0] * (w - len(table))
+
+    def run_prefill(self, v, pool: BlockPool, prompt: Sequence[int],
+                    table: Sequence[int]) -> np.ndarray:
+        """Write `prompt`'s K/V through `table`, return the next-token
+        logits [V]. Batch 1: one compile per prompt bucket."""
+        self._check_version(v)
+        n = len(prompt)
+        tb = self.prompt_bucket_for(n)
+        tokens = np.zeros((1, tb), np.int32)
+        tokens[0, :n] = np.asarray(prompt, np.int32)
+        exec_ = self.prefill_exec(v, tb)
+        pool.cache, logits = exec_(
+            v.snapshot.data, pool.cache, jnp.asarray(tokens),
+            jnp.asarray([n], jnp.int32),
+            jnp.asarray([self._pad_table(table)], jnp.int32))
+        return np.asarray(logits)[0]
+
+    def run_tick(self, v, pool: BlockPool, tokens: Sequence[int],
+                 positions: Sequence[int], tables: Sequence[Sequence[int]],
+                 bucket: int) -> np.ndarray:
+        """One decode tick over `len(tokens)` live rows padded up to
+        `bucket` (pad rows park at the trash block, length 1, and their
+        logits are discarded by the caller). Returns logits [rows, V]."""
+        self._check_version(v)
+        rows = len(tokens)
+        if rows > bucket:
+            raise ServingError(f"{rows} rows > decode bucket {bucket}")
+        tok = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        tab = np.zeros((bucket, self.spec.table_width), np.int32)
+        tok[:rows] = np.asarray(tokens, np.int32)
+        pos[:rows] = np.asarray(positions, np.int32)
+        for i, t in enumerate(tables):
+            tab[i] = self._pad_table(t)
+        exec_ = self.decode_exec(v, bucket)
+        pool.cache, logits = exec_(
+            v.snapshot.data, pool.cache, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(tab))
+        return np.asarray(logits)[:rows]

@@ -140,10 +140,10 @@ class ServableVersion:
 
     __slots__ = ("name", "version", "precision", "buckets", "example_shape",
                  "snapshot", "state", "runners", "model_kind", "source",
-                 "created_at", "param_bytes")
+                 "created_at", "param_bytes", "model")
 
     def __init__(self, name, precision, buckets, example_shape, snapshot,
-                 state, runners, model_kind, source):
+                 state, runners, model_kind, source, model=None):
         self.name = name
         self.version = 0            # assigned at the atomic flip
         self.precision = precision
@@ -156,6 +156,11 @@ class ServableVersion:
         self.source = source
         self.created_at = time.time()
         self.param_bytes = snapshot.nbytes()
+        # the live model object (layer configs + predict_fn): the decode
+        # plane walks its layer stack to build the KV-cache step; the
+        # stateless runners already close over it via predict_fn, so
+        # keeping the reference here costs nothing extra
+        self.model = model
 
     def bucket_for(self, rows: int) -> int:
         for b in self.buckets:
@@ -378,7 +383,12 @@ class ModelRegistry:
         sig = _abstract_sig(snapshot, state, precision)
         runners = {}
         for b in buckets:
-            key = sig + (b,)
+            # namespaced key: the stateless plane and the decode plane
+            # (serving/decode, keys ("decode", sig, phase, ...)) share one
+            # executable cache per model entry, so the plane tag keeps a
+            # generate-capable servable and its stateless twin from ever
+            # colliding on (or evicting) each other's executables
+            key = ("fwd", sig, b)
             compiled = entry.compiled.get(key)
             if compiled is None:
                 x_spec = jax.ShapeDtypeStruct((b,) + shape, jnp.float32)
@@ -391,19 +401,43 @@ class ModelRegistry:
         # bound the executable cache: keep the current and the previous
         # architecture's executables (A/B rollback stays compile-free),
         # drop older — a long-lived server cycling checkpoints must not
-        # grow its compiled set without limit
+        # grow its compiled set without limit. Pruning filters on the SIG
+        # element (key[1]) so decode-plane executables for a kept sig
+        # survive a stateless swap and vice versa
         if sig in entry.sig_history:
             entry.sig_history.remove(sig)
         entry.sig_history.insert(0, sig)
         if len(entry.sig_history) > 2:
             keep = set(entry.sig_history[:2])
             del entry.sig_history[2:]
-            for key in [k for k in entry.compiled if k[:-1] not in keep]:
+            for key in [k for k in entry.compiled if k[1] not in keep]:
                 del entry.compiled[key]
         return ServableVersion(name, precision, buckets, shape, snapshot,
-                               state, runners, type(model).__name__, src)
+                               state, runners, type(model).__name__, src,
+                               model=model)
 
-    def _record_compile(self, name: str, bucket: int, wall_s: float):
+    def compile_cached(self, name: str, key: tuple, build, label: str):
+        """AOT-compile through `name`'s shared executable cache: return the
+        cached executable under namespaced `key` (("decode", sig, phase,
+        bucket) for the generation plane) or run `build()` (a lower+compile
+        closure) once under the entry's swap lock and cache it. `label` is
+        the compile-accounting bucket tag (e.g. "decode4", "prefill1x32")
+        — one `record_aot` per cache miss, so the server-lifetime compile
+        invariant ("one XLA compile per signature") is auditable from the
+        CompileWatcher report exactly like the stateless buckets."""
+        with self._lock:
+            entry = self._entries.setdefault(name, _Entry())
+        with entry.swap_lock:
+            compiled = entry.compiled.get(key)
+            if compiled is None:
+                t0 = time.perf_counter()
+                compiled = build()
+                self._record_compile(name, label,
+                                     time.perf_counter() - t0)
+                entry.compiled[key] = compiled
+        return compiled
+
+    def _record_compile(self, name: str, bucket, wall_s: float):
         self._compiles.inc(model=name, bucket=str(bucket))
         self._compile_s.observe(wall_s, model=name)
         from ..telemetry import runtime

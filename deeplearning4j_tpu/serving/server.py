@@ -12,6 +12,11 @@ Endpoints
                                        "precision"?, "buckets"?,
                                        "input_shape"?}
                                       -> {"model":, "version":, ...}
+    POST /v1/models/<name>/generate   {"prompt": [ids], "max_tokens"?,
+                                       "temperature"?, "stop"?: [ids],
+                                       "seed"?}
+                                      -> {"tokens": [ids],
+                                          "finish_reason": ..., ...}
     GET  /healthz                     -> {"status": "ok", "models": {...}}
     GET  /metrics                     -> Prometheus text (0.0.4)
 
@@ -34,12 +39,13 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .batcher import BatcherClosedError, DynamicBatcher
+from .decode.scheduler import GenerationScheduler
 from .registry import (ModelRegistry, ServingError, UnknownModelError,
                        _validate_features)
 
 __all__ = ["InferenceServer", "ClientError"]
 
-_MODEL_PATH = re.compile(r"^/v1/models/([^/]+)(?:/(predict|swap))?$")
+_MODEL_PATH = re.compile(r"^/v1/models/([^/]+)(?:/(predict|swap|generate))?$")
 
 
 class ClientError(ValueError):
@@ -87,6 +93,7 @@ class InferenceServer:
         self.max_batch = max_batch
         self._batchers: Dict[str, DynamicBatcher] = {}
         self._batchers_lock = threading.Lock()
+        self._schedulers: Dict[str, GenerationScheduler] = {}
         self._stopping = False
         self._started_at = time.time()
         m = self.registry.metrics
@@ -142,6 +149,43 @@ class InferenceServer:
                     metrics=reg.metrics, buckets=v.buckets)
                 self._batchers[name] = b
             return b
+
+    # -- generation plane ------------------------------------------------
+    def enable_generation(self, name: str, **opts) -> GenerationScheduler:
+        """Attach a GenerationScheduler (continuous batching + paged KV
+        cache) to servable `name`. `opts` pass through to the scheduler
+        (mode, block_len, num_blocks, kv_dtype, decode_buckets, ...).
+        Idempotent for a given name; called lazily with defaults by the
+        first /generate request if never called explicitly."""
+        with self._batchers_lock:
+            if self._stopping:
+                raise BatcherClosedError("server is stopping")
+            sched = self._schedulers.get(name)
+            if sched is None:
+                sched = GenerationScheduler(
+                    self.registry, name, metrics=self.registry.metrics,
+                    **opts)
+                self._schedulers[name] = sched
+            return sched
+
+    def disable_generation(self, name: str):
+        """Drain and detach `name`'s scheduler (bench windows swap
+        continuous/static schedulers on one server this way)."""
+        with self._batchers_lock:
+            sched = self._schedulers.pop(name, None)
+        if sched is not None:
+            sched.stop(drain=True)
+
+    def generate(self, name: str, prompt, *, max_tokens: int = 16,
+                 temperature: float = 0.0, stop=(), seed=None,
+                 timeout: Optional[float] = None) -> Dict:
+        self.registry.get(name)                     # -> 404 if unknown
+        sched = self._schedulers.get(name)
+        if sched is None:
+            sched = self.enable_generation(name)
+        return sched.submit(prompt, max_tokens=max_tokens,
+                            temperature=temperature, stop=stop, seed=seed,
+                            timeout=timeout)
 
     def predict(self, name: str, features, batched: Optional[bool] = None
                 ) -> Tuple[np.ndarray, int, str]:
@@ -236,6 +280,31 @@ class InferenceServer:
                                           "batched": path == "batched",
                                           "output": out.tolist()},
                                     endpoint=endpoint, model=model)
+                    elif m and m.group(2) == "generate" and method == "POST":
+                        endpoint, model = "generate", m.group(1)
+                        body = parse_json_body(self)
+                        try:
+                            prompt = [int(t) for t in require(body, "prompt")]
+                            max_tokens = int(body.get("max_tokens", 16))
+                            temperature = float(body.get("temperature", 0.0))
+                            stop = [int(t) for t in (body.get("stop") or ())]
+                            seed = body.get("seed")
+                            seed = None if seed is None else int(seed)
+                        except ClientError:
+                            raise
+                        except (TypeError, ValueError) as e:
+                            raise ClientError(
+                                f"invalid generate parameters: {e}") \
+                                from None
+                        with srv._latency.time(model=model, path="generate"):
+                            res = srv.generate(
+                                model, prompt, max_tokens=max_tokens,
+                                temperature=temperature, stop=stop,
+                                seed=seed)
+                        self._reply(200, dict(
+                            model=model,
+                            version=srv.registry.get(model).version, **res),
+                            endpoint=endpoint, model=model)
                     elif m and m.group(2) == "swap" and method == "POST":
                         endpoint, model = "swap", m.group(1)
                         body = parse_json_body(self)
@@ -307,8 +376,12 @@ class InferenceServer:
         with self._batchers_lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            schedulers = list(self._schedulers.values())
+            self._schedulers.clear()
         for b in batchers:
             b.stop(drain=True)
+        for s in schedulers:
+            s.stop(drain=True)
         self._httpd.server_close()
         self._thread = None
 

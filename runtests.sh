@@ -14,6 +14,14 @@
 #   ./runtests.sh serving    serving smoke: unit/HTTP tests plus a live
 #                            end-to-end pass (ephemeral port, predict,
 #                            hot-swap, /metrics scrape, clean shutdown)
+#   ./runtests.sh decode     autoregressive decode smoke: the KV-cache
+#                            generation suite (prefill+ticks vs full-
+#                            forward greedy equivalence, paged-block
+#                            reuse bit-exactness, join/leave isolation,
+#                            continuous batching, /generate HTTP, IR
+#                            probes) plus one paired continuous-vs-
+#                            static generation bench rep (tokens/s
+#                            ratio, p99, compile accounting)
 #   ./runtests.sh zero       ZeRO sharded-optimizer smoke: the replicated-
 #                            vs-zero1/zero2 equivalence suite on the
 #                            8-device virtual mesh plus one scaling_bench
@@ -77,6 +85,14 @@ if [[ "${1:-}" == "serving" ]]; then
     echo "=== serving smoke ==="
     python -m pytest tests/test_serving.py -q
     exec python -m deeplearning4j_tpu.serving.server --smoke
+fi
+if [[ "${1:-}" == "decode" ]]; then
+    echo "=== autoregressive decode smoke ==="
+    python -m pytest tests/test_decode.py -q
+    echo "=== paired continuous-vs-static generation bench rep ==="
+    exec env JAX_PLATFORMS=cpu \
+        python -m deeplearning4j_tpu.serving.decode.bench \
+        --clients 4 --requests 2 --pairs 2
 fi
 if [[ "${1:-}" == "zero" ]]; then
     echo "=== ZeRO sharded-optimizer smoke ==="

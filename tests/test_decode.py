@@ -1,0 +1,572 @@
+"""Autoregressive decode plane (ISSUE 16): KV-cache generation.
+
+The contracts under test, from strongest to weakest:
+
+  * BIT-exact: a row's decode logits are identical whether its batch
+    neighbours exist or not (join/leave isolation), and a reused KV
+    block produces bit-identical logits to a fresh allocation — both
+    fall out of exact-zero masked softmax weights plus row-independent
+    compiled steps.
+  * Greedy-exact: prefill + N decode ticks produce the IDENTICAL token
+    sequence as running the full forward from scratch each step (the
+    argmax survives the reduction-grouping noise), across evictions,
+    re-prefills and hot-swaps.
+  * allclose: the decode-path logits match the full-sequence forward to
+    f32 tolerance (reduction trees differ with padding).
+
+Plus the serving integration: one XLA compile per (model, phase,
+bucket) for the server's lifetime including same-architecture swaps,
+decode/fwd executable-cache keys that never collide, the FlushEma
+bucket-extrapolation fix, continuous batching under KV pressure, the
+/generate HTTP endpoint, and the decode IR probes (clean + seeded
+donation mutation).
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (Adam, EmbeddingSequenceLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                RnnOutputLayer, TransformerBlock)
+from deeplearning4j_tpu.kernels.attention import attention_reference
+from deeplearning4j_tpu.serving.batcher import FlushEma
+from deeplearning4j_tpu.serving.decode import (DecodeEngine,
+                                               GenerationError,
+                                               GenerationScheduler,
+                                               OutOfBlocksError)
+from deeplearning4j_tpu.serving.registry import ModelRegistry, ServingError
+
+pytestmark = pytest.mark.sanitize(
+    allow_threads=("dl4j-decode-sched-", "dl4j-serving-http"))
+
+VOCAB, WIDTH, TMAX = 32, 16, 32
+
+
+def lm(seed=0, vocab=VOCAB, width=WIDTH, t=TMAX, blocks=2):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+         .list().layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width)))
+    for _ in range(blocks):
+        b = b.layer(TransformerBlock(n_heads=4))
+    conf = (b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, t)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def eager_logits(model, ctx):
+    """Full-sequence forward, eager (no jit, no padding): next-token
+    logits after `ctx` — the decode plane's ground truth."""
+    x = jnp.asarray(ctx, jnp.int32)[None, :, None]
+    h, _, _, _ = model._forward(model.params, model.state, x, False, None,
+                                upto=len(model.layers) - 1)
+    return np.asarray(
+        model.layers[-1].preout(model.params[-1], {}, h)[0, -1],
+        np.float32)
+
+
+def eager_greedy(model, prompt, n):
+    ctx = list(prompt)
+    for _ in range(n):
+        ctx.append(int(np.argmax(eager_logits(model, ctx))))
+    return ctx[len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def served():
+    """Module-shared registry + greedy continuous scheduler over a tiny
+    2-block LM — decode/prefill compiles amortized across tests."""
+    reg = ModelRegistry()
+    model = lm(seed=3)
+    reg.register("gen", model, buckets=(1,))
+    sched = GenerationScheduler(reg, "gen", block_len=4,
+                                decode_buckets=(1, 2, 4))
+    yield reg, model, sched
+    sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# kernels: explicit per-row valid length
+# ---------------------------------------------------------------------------
+
+def test_attention_kv_length_matches_sliced_full():
+    """`kv_length` masking == running full attention over only the
+    valid prefix, per row (the gather's trash-slot reads must be exact
+    no-ops)."""
+    r = np.random.default_rng(0)
+    B, T, D = 3, 8, 4
+    q = jnp.asarray(r.normal(size=(B, 1, D)).astype(np.float32))
+    k = jnp.asarray(r.normal(size=(B, T, D)).astype(np.float32))
+    v = jnp.asarray(r.normal(size=(B, T, D)).astype(np.float32))
+    lengths = [3, 8, 5]
+    out = attention_reference(
+        q, k, v, causal=True,
+        q_positions=jnp.asarray([[n - 1] for n in lengths], jnp.int32),
+        kv_length=jnp.asarray(lengths, jnp.int32))
+    for b, n in enumerate(lengths):
+        ref = attention_reference(q[b:b + 1], k[b:b + 1, :n],
+                                  v[b:b + 1, :n])
+        np.testing.assert_array_equal(np.asarray(out[b]),
+                                      np.asarray(ref[0]))
+
+
+def test_attention_kv_length_garbage_slots_inert():
+    """Slots past kv_length may hold ANY finite garbage without
+    changing a single output bit (the decode plane's trash block)."""
+    r = np.random.default_rng(1)
+    q = jnp.asarray(r.normal(size=(2, 1, 4)).astype(np.float32))
+    k = jnp.asarray(r.normal(size=(2, 6, 4)).astype(np.float32))
+    v = jnp.asarray(r.normal(size=(2, 6, 4)).astype(np.float32))
+    kw = dict(causal=True,
+              q_positions=jnp.asarray([[3], [2]], jnp.int32),
+              kv_length=jnp.asarray([4, 3], jnp.int32))
+    a = attention_reference(q, k, v, **kw)
+    k2 = k.at[:, 4:].set(1e9)
+    v2 = v.at[:, 4:].set(-1e9)
+    b = attention_reference(q, k2, v2, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# tentpole: prefill + ticks vs full-sequence forward
+# ---------------------------------------------------------------------------
+
+def test_engine_prefill_and_ticks_match_full_forward(served):
+    """Per-step logits allclose + greedy argmax identical: the KV-cache
+    path IS the full forward, incrementally."""
+    reg, model, sched = served
+    eng, v = sched.engine, reg.get("gen")
+    pool = eng.new_pool()
+    prompt = [5, 11, 2, 29, 7]
+    blocks = pool.alloc(eng.spec.blocks_for(len(prompt)))
+    logits = eng.run_prefill(v, pool, prompt, blocks)
+    ctx = list(prompt)
+    for step in range(10):
+        ref = eager_logits(model, ctx)
+        np.testing.assert_allclose(logits, ref, rtol=2e-5, atol=1e-6)
+        assert int(np.argmax(logits)) == int(np.argmax(ref)), \
+            f"greedy diverged at step {step}"
+        tok = int(np.argmax(logits))
+        ctx.append(tok)
+        pos = len(ctx) - 1
+        need = eng.spec.blocks_for(pos + 1) - len(blocks)
+        if need:
+            blocks += pool.alloc(need)
+        logits = eng.run_tick(v, pool, [tok], [pos], [blocks], bucket=1)[0]
+    pool.release(blocks)
+
+
+def test_scheduler_greedy_identical_to_full_forward(served):
+    reg, model, sched = served
+    prompt = [3, 7, 1, 4, 9, 2]
+    res = sched.submit(prompt, max_tokens=10, timeout=300)
+    assert res["tokens"] == eager_greedy(model, prompt, 10)
+    assert res["finish_reason"] == "length"
+    assert res["generated_tokens"] == 10
+    # deterministic: resubmitting replays the identical sequence
+    assert sched.submit(prompt, max_tokens=10,
+                        timeout=300)["tokens"] == res["tokens"]
+
+
+def test_scheduler_concurrent_clients_all_greedy_exact(served):
+    """Token-granularity joins/leaves while neighbours are mid-flight:
+    every client still gets its exact single-sequence greedy answer."""
+    reg, model, sched = served
+    prompts = [[1 + i, 8, 2 * i + 1, 5] for i in range(6)]
+    want = [eager_greedy(model, p, 6 + i % 3)
+            for i, p in enumerate(prompts)]
+    got = [None] * len(prompts)
+
+    def client(i):
+        got[i] = sched.submit(prompts[i], max_tokens=6 + i % 3,
+                              timeout=300)["tokens"]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+
+
+def test_stop_token_and_context_cap(served):
+    reg, model, sched = served
+    prompt = [3, 7, 1, 4, 9, 2]
+    full = eager_greedy(model, prompt, 10)
+    stop = full[3]
+    res = sched.submit(prompt, max_tokens=10, stop=[stop], timeout=300)
+    assert res["finish_reason"] == "stop"
+    # cut at the stop token's FIRST occurrence (greedy chains repeat)
+    assert res["tokens"] == full[:full.index(stop)]
+    res = sched.submit(prompt, max_tokens=10_000, timeout=300)
+    assert res["finish_reason"] == "context"
+    assert len(prompt) + res["generated_tokens"] == TMAX
+    with pytest.raises(GenerationError):
+        sched.submit(list(range(TMAX)), timeout=300)
+
+
+def test_temperature_sampling_seeded(served):
+    reg, model, sched = served
+    kw = dict(max_tokens=8, temperature=0.9, seed=42, timeout=300)
+    a = sched.submit([4, 9, 1], **kw)
+    b = sched.submit([4, 9, 1], **kw)
+    assert a["tokens"] == b["tokens"]
+    assert all(0 <= t < VOCAB for t in a["tokens"])
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: isolation + block reuse
+# ---------------------------------------------------------------------------
+
+def test_join_leave_neighbour_isolation_bitexact(served):
+    """A row's tick logits are bit-identical with and without a batch
+    neighbour (same bucket, so the compiled step is the same)."""
+    reg, model, sched = served
+    eng, v = sched.engine, reg.get("gen")
+    pa, pb = [5, 11, 2, 29, 7], [1, 2, 3]
+
+    def run(with_neighbour):
+        pool = eng.new_pool()
+        ba = pool.alloc(eng.spec.blocks_for(len(pa) + 1))
+        la = eng.run_prefill(v, pool, pa, ba)
+        rows = [(int(np.argmax(la)), len(pa), ba)]
+        if with_neighbour:
+            bb = pool.alloc(eng.spec.blocks_for(len(pb) + 1))
+            lb = eng.run_prefill(v, pool, pb, bb)
+            rows.append((int(np.argmax(lb)), len(pb), bb))
+        out = eng.run_tick(v, pool, [r[0] for r in rows],
+                           [r[1] for r in rows], [r[2] for r in rows],
+                           bucket=2)
+        return la, out[0]
+
+    la2, tick2 = run(True)
+    la1, tick1 = run(False)
+    np.testing.assert_array_equal(la1, la2)       # prefill: same blocks
+    np.testing.assert_array_equal(tick1, tick2)   # tick: neighbour inert
+
+
+def test_kv_block_reuse_after_release_bitexact(served):
+    """Blocks freed by one sequence and recycled by another behave
+    bit-identically to a fresh allocation — logits AND the arena slots
+    actually covered by the new sequence."""
+    reg, model, sched = served
+    eng, v = sched.engine, reg.get("gen")
+    pa, pb = [9, 9, 9, 9, 9, 9, 9], [4, 1, 6, 2, 8]
+
+    def gen3(pool, blocks):
+        out = [eng.run_prefill(v, pool, pb, blocks)]
+        ctx = list(pb)
+        for _ in range(3):
+            tok = int(np.argmax(out[-1]))
+            ctx.append(tok)
+            need = eng.spec.blocks_for(len(ctx)) - len(blocks)
+            if need:
+                blocks += pool.alloc(need)
+            out.append(eng.run_tick(v, pool, [tok], [len(ctx) - 1],
+                                    [blocks], bucket=1)[0])
+        return blocks, out
+
+    pool1 = eng.new_pool()
+    stale = pool1.alloc(eng.spec.blocks_for(len(pa)))
+    eng.run_prefill(v, pool1, pa, stale)          # dirty the blocks
+    pool1.release(stale)
+    reused = pool1.alloc(eng.spec.blocks_for(len(pb)))
+    assert set(reused) <= set(stale)              # LIFO recycles them
+    reused, out1 = gen3(pool1, reused)
+
+    pool2 = eng.new_pool()
+    fresh = pool2.alloc(eng.spec.blocks_for(len(pb)))
+    fresh, out2 = gen3(pool2, fresh)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(a, b)
+    kv1 = np.asarray(pool1.cache["kv"])[reused]
+    kv2 = np.asarray(pool2.cache["kv"])[fresh]
+    np.testing.assert_array_equal(kv1, kv2)
+
+
+def test_eviction_resume_greedy_exact_and_counted():
+    """Under KV-block pressure the scheduler preempts sequences (blocks
+    freed, ctx re-prefilled on re-admission) — every client still gets
+    the exact greedy answer and the eviction counter moved."""
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    reg = ModelRegistry()
+    model = lm(seed=5)
+    reg.register("gen", model, buckets=(1,))
+    metrics = MetricsRegistry()
+    # 7 usable blocks of 4 slots: three 16-token sequences cannot all
+    # be resident -> continuous batching must juggle via eviction
+    sched = GenerationScheduler(reg, "gen", block_len=4, num_blocks=8,
+                                decode_buckets=(1, 2, 4),
+                                metrics=metrics)
+    try:
+        prompts = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+        want = [eager_greedy(model, p, 12) for p in prompts]
+        got = [None] * 3
+
+        def client(i):
+            got[i] = sched.submit(prompts[i], max_tokens=12,
+                                  timeout=300)["tokens"]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert got == want
+        evicted = metrics.counter(
+            "dl4j_decode_evictions_total", "",
+            labels=("model",)).value(model="gen")
+        assert evicted >= 1, "pressure never forced an eviction"
+        assert sched.pool.used_blocks() == 0
+    finally:
+        sched.stop()
+
+
+def test_single_sequence_larger_than_pool_fails_cleanly():
+    reg = ModelRegistry()
+    reg.register("gen", lm(seed=5), buckets=(1,))
+    sched = GenerationScheduler(reg, "gen", block_len=4, num_blocks=3,
+                                decode_buckets=(1,))
+    try:
+        with pytest.raises((GenerationError, OutOfBlocksError)):
+            sched.submit([1, 2, 3], max_tokens=20, timeout=300)
+        assert sched.pool.used_blocks() == 0
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_cache_generates():
+    reg = ModelRegistry()
+    model = lm(seed=6)
+    reg.register("gen", model, buckets=(1,))
+    sched = GenerationScheduler(reg, "gen", block_len=4, kv_dtype="int8",
+                                decode_buckets=(1, 2))
+    try:
+        assert sched.pool.cache["kv"].dtype == jnp.int8
+        assert "scale" in sched.pool.cache
+        res = sched.submit([3, 7, 1, 4], max_tokens=6, timeout=300)
+        assert res["generated_tokens"] == 6
+        # prefill attends over the LOCAL (unquantized) projections, so
+        # the FIRST sampled token is exact even with an int8 cache
+        assert res["tokens"][0] == eager_greedy(model, [3, 7, 1, 4], 1)[0]
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# compile accounting + executable-cache keys
+# ---------------------------------------------------------------------------
+
+def test_swap_and_generate_one_compile_per_signature():
+    """Server-lifetime compile budget: decode + prefill executables
+    compile ONCE per (phase, bucket) even across a same-architecture
+    hot-swap, and generation picks up the new weights (running
+    sequences re-prefill)."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    m1, m2 = lm(seed=7), lm(seed=8)
+    with telemetry.enabled() as sess:
+        reg = ModelRegistry(metrics=sess.registry)
+        reg.register("gen", m1, buckets=(1,))
+        sched = GenerationScheduler(reg, "gen", block_len=4,
+                                    decode_buckets=(1, 2))
+        try:
+            prompt = [3, 7, 1, 4]
+            assert sched.submit(prompt, max_tokens=5, timeout=300)[
+                "tokens"] == eager_greedy(m1, prompt, 5)
+            import tempfile
+            with tempfile.TemporaryDirectory() as d:
+                ModelSerializer.write_model(m2, f"{d}/m2.zip")
+                reg.swap("gen", f"{d}/m2.zip")
+            assert sched.submit(prompt, max_tokens=5, timeout=300)[
+                "tokens"] == eager_greedy(reg.get("gen").model, prompt, 5)
+        finally:
+            sched.stop()
+        decode_compiles = {
+            k: v["count"] for k, v in sess.compiles.report().items()
+            if k.startswith("serving/gen:b")
+            and ("decode" in k or "prefill" in k)}
+        assert decode_compiles, "decode compiles never recorded"
+        assert all(c == 1 for c in decode_compiles.values()), \
+            decode_compiles
+
+
+def test_registry_decode_and_fwd_cache_keys_disjoint():
+    """Regression (satellite f): the decode plane's executables live
+    under ("decode", sig, phase, bucket) keys and the stateless plane's
+    under ("fwd", sig, bucket) — enabling generation on a servable must
+    not evict its forward runners, nor vice versa."""
+    reg = ModelRegistry()
+    model = lm(seed=9)
+    reg.register("gen", model, buckets=(1,))
+    entry = reg._entries["gen"]
+    fwd_keys = {k for k in entry.compiled if k[0] == "fwd"}
+    assert fwd_keys, "stateless runners missing"
+    eng = DecodeEngine(reg, "gen", block_len=4, decode_buckets=(1,))
+    v = reg.get("gen")
+    eng.prefill_exec(v, 8)
+    eng.decode_exec(v, 1)
+    keys = set(entry.compiled)
+    assert fwd_keys <= keys, "decode compilation evicted fwd runners"
+    decode_keys = {k for k in keys if k[0] == "decode"}
+    assert {k[2:] for k in decode_keys} == {("prefill", 8), ("tick", 1)}
+    # stateless twin under another name: its own per-model cache holds
+    # only fwd keys — the planes can never evict each other
+    reg.register("twin", lm(seed=9), buckets=(1,))
+    assert all(k[0] == "fwd" for k in reg._entries["twin"].compiled)
+
+
+def test_flush_ema_bucket_extrapolation():
+    """Regression (satellite f): estimating an UNSAMPLED bucket must
+    scale from the nearest LARGER sampled bucket (floored by smaller
+    ones), not the nearest-by-distance — with {1: 0.1ms, 32: 10ms}
+    sampled, bucket 8's estimate comes from 32, not from 1."""
+    ema = FlushEma()
+    ema.observe(1, 1e-4)
+    ema.observe(32, 1e-2)
+    est = ema.estimate(8)
+    assert est == pytest.approx(1e-2 * 8 / 32)     # from bucket 32
+    assert est > 1e-4                              # monotone floor
+    # above the largest sample: linear extrapolation from it
+    assert ema.estimate(64) == pytest.approx(1e-2 * 64 / 32)
+    # sampled buckets return their own EMA untouched
+    assert ema.estimate(32) == pytest.approx(1e-2)
+    # flush choice: at avail=5 with a fast full bucket 4 vs padding to
+    # 8, rows/s decides
+    ema2 = FlushEma()
+    ema2.observe(4, 1e-3)
+    ema2.observe(8, 1e-2)       # padding up is 10x worse
+    assert ema2.pick_rows(5, [1, 2, 4, 8], 8) == 4
+    ema3 = FlushEma()
+    ema3.observe(4, 1e-3)
+    ema3.observe(8, 1.1e-3)     # padding up is nearly free
+    assert ema3.pick_rows(5, [1, 2, 4, 8], 8) == 5
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+def _http(method, url, body=None, timeout=120):
+    req = urllib.request.Request(
+        url, None if body is None else json.dumps(body).encode(),
+        {"Content-Type": "application/json"}, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_generate_http_endpoint():
+    from deeplearning4j_tpu.serving.server import InferenceServer
+
+    model = lm(seed=11)
+    srv = InferenceServer(batching=False).start()
+    try:
+        srv.registry.register("gen", model, buckets=(1,))
+        srv.enable_generation("gen", block_len=4, decode_buckets=(1, 2))
+        base = f"http://{srv.host}:{srv.port}"
+        prompt = [3, 7, 1, 4]
+        out = _http("POST", f"{base}/v1/models/gen/generate",
+                    {"prompt": prompt, "max_tokens": 6})
+        assert out["tokens"] == eager_greedy(model, prompt, 6)
+        assert out["finish_reason"] == "length" and out["version"] == 1
+        out2 = _http("POST", f"{base}/v1/models/gen/generate",
+                     {"prompt": prompt, "max_tokens": 6})
+        assert out2["tokens"] == out["tokens"]
+        # generation metrics exported on /metrics
+        req = urllib.request.Request(f"{base}/metrics")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            text = resp.read().decode()
+        for family in ("dl4j_decode_tokens_total", "dl4j_decode_kv_blocks",
+                       "dl4j_decode_admissions_total",
+                       "dl4j_decode_phase_seconds"):
+            assert family in text, f"{family} missing from /metrics"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http("POST", f"{base}/v1/models/gen/generate", {})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http("POST", f"{base}/v1/models/gen/generate",
+                  {"prompt": prompt, "max_tokens": "lots of"})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http("POST", f"{base}/v1/models/nope/generate",
+                  {"prompt": prompt})
+        assert ei.value.code == 404
+        # a non-generate-capable model -> 400 (ServingError), not 500
+        from deeplearning4j_tpu import (DenseLayer, OutputLayer, Sgd)
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+                .list().layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=4, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6)).build())
+        srv.registry.register("mlp", MultiLayerNetwork(conf).init(),
+                              buckets=(1,))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http("POST", f"{base}/v1/models/mlp/generate",
+                  {"prompt": prompt})
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_scheduler_stop_rejects_new_submissions():
+    reg = ModelRegistry()
+    reg.register("gen", lm(seed=12), buckets=(1,))
+    sched = GenerationScheduler(reg, "gen", block_len=4,
+                                decode_buckets=(1,))
+    res = sched.submit([1, 2, 3], max_tokens=2, timeout=300)
+    assert res["generated_tokens"] == 2
+    sched.stop()
+    with pytest.raises(GenerationError):
+        sched.submit([1, 2, 3], max_tokens=2)
+
+
+def test_non_transformer_stack_rejected():
+    from deeplearning4j_tpu import DenseLayer, OutputLayer, Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list().layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    reg = ModelRegistry()
+    reg.register("mlp", MultiLayerNetwork(conf).init(), buckets=(1,))
+    with pytest.raises(ServingError):
+        DecodeEngine(reg, "mlp")
+
+
+# ---------------------------------------------------------------------------
+# IR probes (satellite a)
+# ---------------------------------------------------------------------------
+
+def test_ir_decode_probes_clean():
+    """Both decode-plane jit entries (prefill, tick) trace, lower and
+    compile clean: the donated cache pytree aliases its output arena
+    and a single-device step measures zero collective bytes."""
+    from deeplearning4j_tpu.analysis import ir, ir_probes
+
+    for entry in ir_probes.decode_entries():
+        found = ir.analyze_entry(entry)
+        assert not found, [f.render() for f in found]
+
+
+def test_ir_decode_donated_tokens_caught():
+    """Seeded mutation (acceptance): donating the int32 token ids —
+    which can alias nothing in the f32 outputs — must trip
+    ir-ineffective-donation on the decode tick entry."""
+    from deeplearning4j_tpu.analysis import ir, ir_probes
+
+    entry = ir_probes.decode_entry("tick", mutate="donate_tokens")
+    found = ir.analyze_entry(entry)
+    assert any(f.rule == "ir-ineffective-donation" for f in found), \
+        [f.render() for f in found]
