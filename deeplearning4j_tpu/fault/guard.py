@@ -28,9 +28,11 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import time
 
 from . import metrics as _m
+from ..telemetry.recorder import flight_recorder
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -74,11 +76,16 @@ class TrainingGuard:
     def __init__(self, policy: str = GuardPolicy.WARN, *,
                  refresh_every: int = 10, max_consecutive: int = 25,
                  max_retries: int = 3, backoff_s: float = 0.05,
-                 backoff_max_s: float = 2.0):
+                 backoff_max_s: float = 2.0, flight_dump_dir=None):
         if policy not in GuardPolicy.ALL:
             raise ValueError(f"unknown guard policy {policy!r}; choose from "
                              f"{GuardPolicy.ALL}")
         self.policy = policy
+        # where the flight-recorder dump lands when the guard trips; None
+        # keeps it in-memory only (recorder.last_dump / the HTTP debug
+        # endpoint)
+        self.flight_dump_dir = flight_dump_dir
+        self.last_flight_dump = None
         self.refresh_every = max(1, int(refresh_every))
         self.max_consecutive = int(max_consecutive)
         self.max_retries = int(max_retries)
@@ -134,6 +141,13 @@ class TrainingGuard:
         step_fn()
         import jax.numpy as jnp
         score = float(jnp.asarray(model._score))
+        rec = flight_recorder()
+        if rec.enabled:
+            # the score is ALREADY host-materialized here (the guard's
+            # sanctioned sync point) — recording it adds no device sync
+            rec.record("train/step", score=score,
+                       iteration=getattr(model, "iteration_count", None),
+                       finite=math.isfinite(score))
         if math.isfinite(score):
             self._consecutive = 0
             self._good_streak += 1
@@ -153,7 +167,16 @@ class TrainingGuard:
         EPOCHS, and a non-finite epoch with no known-good yet falls back
         to the pre-epoch snapshot."""
         import numpy as np
-        bad = int((~np.isfinite(np.asarray(scores, dtype=np.float64))).sum())
+        host = np.asarray(scores, dtype=np.float64)
+        bad = int((~np.isfinite(host)).sum())
+        rec = flight_recorder()
+        if rec.enabled and host.size:
+            finite = host[np.isfinite(host)]
+            rec.record("train/window_scores", n=int(host.size),
+                       nonfinite=bad,
+                       last=float(host[-1]),
+                       lo=float(finite.min()) if finite.size else None,
+                       hi=float(finite.max()) if finite.size else None)
         if bad == 0:
             self._consecutive = 0
             self._good_streak += 1
@@ -166,17 +189,44 @@ class TrainingGuard:
             self._known_good = snap
         return self._handle_nonfinite(model, snap, float("nan"), n=bad)
 
+    def _dump_flightrecord(self, model, score, action: str):
+        """Atomically freeze the flight-recorder ring the moment the guard
+        trips, so the dump holds the failing step plus the events leading
+        up to it (step scores, collective hashes, KV pressure...). Stored
+        on `recorder.last_dump` (served at /debug/flightrecord) and, when
+        `flight_dump_dir` is set, written to a timestamped JSON file."""
+        rec = flight_recorder()
+        if not rec.enabled:
+            return None
+        path = None
+        if self.flight_dump_dir is not None:
+            os.makedirs(self.flight_dump_dir, exist_ok=True)
+            path = os.path.join(
+                self.flight_dump_dir,
+                f"flightrecord-{action}-{int(time.time() * 1000)}.json")
+        doc = rec.dump(reason=f"guard/{action}", path=path, extra={
+            "policy": self.policy,
+            "score": score,
+            "iteration": getattr(model, "iteration_count", None),
+            "nonfinite_steps": self.nonfinite_steps,
+            "consecutive": self._consecutive,
+        })
+        self.last_flight_dump = doc
+        return doc
+
     def _handle_nonfinite(self, model, snap, score, n: int = 1) -> bool:
         self.nonfinite_steps += n
         _m.count_nonfinite(self.policy, n)
         self._consecutive += 1
         self._good_streak = 0
         if self._consecutive > self.max_consecutive:
+            self._dump_flightrecord(model, score, "circuit_breaker")
             raise NonFiniteScoreError(
                 f"{self._consecutive} consecutive non-finite training steps "
                 f"under policy={self.policy!r} — data or learning rate is "
                 "systematically bad, refusing to spin")
         if self.policy == GuardPolicy.HALT:
+            self._dump_flightrecord(model, score, "halt")
             raise NonFiniteScoreError(
                 f"training loss went non-finite ({score}) at iteration "
                 f"{getattr(model, 'iteration_count', '?')} (policy=halt)")
@@ -188,6 +238,7 @@ class TrainingGuard:
                 getattr(model, "iteration_count", "?"))
             return True
         if self.policy == GuardPolicy.SKIP_BATCH:
+            self._dump_flightrecord(model, score, "skip_batch")
             self._restore(model, snap)
             self.skipped_batches += 1
             _m.count_rollback(self.policy)
@@ -196,6 +247,7 @@ class TrainingGuard:
                 "restored to pre-batch snapshot (policy=skip_batch)", score)
             return False
         # ROLLBACK
+        self._dump_flightrecord(model, score, "rollback")
         self._restore(model, self._known_good)
         self.skipped_batches += 1
         _m.count_rollback(self.policy)

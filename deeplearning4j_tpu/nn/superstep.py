@@ -62,6 +62,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..telemetry.recorder import flight_recorder
 from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -482,7 +483,17 @@ class SuperstepRunner:
 
     def _observe_auto(self, window, dispatch_s: float, period_s: float):
         """Feed a FULL window's measured timings to the overlap-aware
-        auto-K policy (partial tail windows would understate the ratio)."""
+        auto-K policy (partial tail windows would understate the ratio) —
+        and the window's step anatomy (dispatch/host shares per optimizer
+        step) to the flight recorder, for EVERY window including tails."""
+        rec = flight_recorder()
+        if rec.enabled:
+            rec.record("train/window", micro=len(window),
+                       n_steps=self._steps_in(len(window)),
+                       dispatch_s=round(dispatch_s, 6),
+                       period_s=round(period_s, 6),
+                       dispatch_share=round(
+                           dispatch_s / period_s, 4) if period_s > 0 else None)
         if self._autok is None or len(window) != self._k * self._m:
             return
         new_k = self._autok.observe(dispatch_s, period_s)
@@ -654,6 +665,17 @@ class SuperstepRunner:
                 micro_scores = [np.asarray(ms) for _, ms in scores_dev]
         n_steps = len(host_scores)
         kept = True
+        rec = flight_recorder()
+        if rec.enabled and self.guard is None and n_steps:
+            # guarded fits record scores inside guard.check_scores; the
+            # unguarded path feeds the same already-host-synced vector
+            # here so a post-hoc dump still shows the loss trajectory
+            finite = host_scores[np.isfinite(host_scores)]
+            rec.record("train/window_scores", n=n_steps,
+                       nonfinite=int(n_steps - finite.size),
+                       last=float(host_scores[-1]),
+                       lo=float(finite.min()) if finite.size else None,
+                       hi=float(finite.max()) if finite.size else None)
         if self.guard is not None:
             # superstep-granular guard: a bad window is discarded WHOLE,
             # restoring the pre-superstep snapshot (params/updater/RNG/

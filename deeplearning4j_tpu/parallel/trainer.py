@@ -1083,9 +1083,11 @@ class ParallelTrainer:
         bucket layout diverged after an elastic resize hashes differently
         BEFORE it deadlocks the mesh inside a mismatched collective."""
         from ..analysis.sanitizer import current_collective_hasher
+        from ..telemetry.recorder import flight_recorder
 
         h = current_collective_hasher()
-        if h is None or self._zero_info is None:
+        rec = flight_recorder()
+        if self._zero_info is None or (h is None and not rec.enabled):
             return
         info = self._zero_info
         rs, nb = info["bytes"].get("reduce_scatter", 0), info["n_buckets"]
@@ -1104,14 +1106,36 @@ class ParallelTrainer:
             n_steps = max(1, int(n_steps))
             m = -(-n_micro // n_steps)
             counts = [m] * (n_steps - 1) + [n_micro - m * (n_steps - 1)]
-        for count in counts:
-            for _ in range(count if rs else 0):
-                h.record("reduce_scatter", rs, n=max(1, nb))
-            for op in ("all_reduce", "all_gather"):
-                b = info["bytes"].get(op, 0)
-                if b:
-                    h.record(op, b)
-            h.end_step()
+        if h is not None:
+            for count in counts:
+                for _ in range(count if rs else 0):
+                    h.record("reduce_scatter", rs, n=max(1, nb))
+                for op in ("all_reduce", "all_gather"):
+                    b = info["bytes"].get(op, 0)
+                    if b:
+                        h.record(op, b)
+                h.end_step()
+        if rec.enabled:
+            # one flight-recorder event per optimizer step carrying the
+            # collective-sequence digest. With a sanitizer hasher the
+            # digest is the live per-step stream it just closed; without
+            # one, a static plan digest (hash of the declared bytes-by-op
+            # + bucket layout) still lets dump comparisons across workers
+            # catch a diverged plan. Pure host-side hashing — no syncs.
+            if h is not None and h.step_digests:
+                digests = h.step_digests[-len(counts):]
+            else:
+                plan = getattr(self, "_collective_plan_digest", None)
+                if plan is None:
+                    import hashlib
+                    basis = repr((sorted(info["bytes"].items()),
+                                  info["n_buckets"]))
+                    plan = hashlib.sha256(basis.encode()).hexdigest()[:16]
+                    self._collective_plan_digest = plan
+                digests = [plan] * len(counts)
+            for count, d in zip(counts, digests):
+                rec.record("train/collectives", digest=d, micro=count,
+                           n_buckets=nb)
 
     @property
     def params_replicated(self) -> bool:

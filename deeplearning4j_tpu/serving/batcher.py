@@ -119,9 +119,9 @@ class BatcherClosedError(RuntimeError):
 
 class _Pending:
     __slots__ = ("x", "rows", "event", "result", "version", "error",
-                 "enqueued_at")
+                 "enqueued_at", "ctx")
 
-    def __init__(self, x: np.ndarray):
+    def __init__(self, x: np.ndarray, ctx=None):
         self.x = x
         self.rows = int(x.shape[0])
         self.event = _thread_event()
@@ -129,6 +129,7 @@ class _Pending:
         self.version: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.perf_counter()
+        self.ctx = ctx      # TraceContext riding the request, or None
 
 
 class DynamicBatcher:
@@ -181,11 +182,13 @@ class DynamicBatcher:
         self._worker.start()
 
     # -- client side -----------------------------------------------------
-    def submit(self, x: np.ndarray, timeout: float = 30.0
+    def submit(self, x: np.ndarray, timeout: float = 30.0, ctx=None
                ) -> Tuple[np.ndarray, int]:
         """Block until this request's rows come back from a batched
         forward. Returns `(outputs, version)`; raises the batch's error if
-        its forward failed, BatcherClosedError after stop()."""
+        its forward failed, BatcherClosedError after stop(). `ctx` is an
+        optional TraceContext: the flush emits queue_wait/batch_forward/
+        scatter child spans against it."""
         if int(x.shape[0]) > self.max_batch:   # oversize fails HERE, alone
             raise ServingError(
                 f"request of {int(x.shape[0])} rows exceeds max_batch "
@@ -193,7 +196,7 @@ class DynamicBatcher:
                 "chunks oversize requests; the batcher never splits one")
         if self._stopped:
             raise BatcherClosedError(f"batcher for '{self.name}' is stopped")
-        p = _Pending(x)
+        p = _Pending(x, ctx)
         self._queue.append(p)
         self._wake.set()
         if self._stopped and not p.event.is_set():
@@ -317,6 +320,12 @@ class DynamicBatcher:
             for p in taken:
                 self._queue_wait_h.observe(t_flush - p.enqueued_at,
                                            model=self.name)
+        for p in taken:
+            if p.ctx is not None:
+                # enqueue -> flush start, stamped with the enqueue time
+                # captured on the client's thread
+                p.ctx.emit("queue_wait", p.enqueued_at, t_flush,
+                           model=self.name, rows=p.rows)
         try:
             x = (taken[0].x if len(taken) == 1
                  else np.concatenate([p.x for p in taken], axis=0))
@@ -325,6 +334,11 @@ class DynamicBatcher:
             out, version = self._runner(pad_rows(x, bucket - rows), bucket)
             dt = time.perf_counter() - t0
             self._flush_ema.observe(bucket, dt)  # worker-thread-only state
+            for p in taken:
+                if p.ctx is not None:
+                    p.ctx.emit("batch_forward", t0, t0 + dt,
+                               model=self.name, bucket=bucket,
+                               batch_rows=rows)
             if self._batch_size_h is not None:
                 self._batch_size_h.observe(rows, model=self.name)
                 self._rows_c.inc(rows, model=self.name, kind="real")
@@ -332,11 +346,17 @@ class DynamicBatcher:
                     self._rows_c.inc(bucket - rows, model=self.name,
                                      kind="pad")
             lo = 0
+            t_scatter = time.perf_counter()
             for p in taken:
                 p.result = out[lo:lo + p.rows]
                 p.version = version
                 lo += p.rows
                 scattered += 1
+                if p.ctx is not None:
+                    # emitted BEFORE event.set(): once the waiter wakes,
+                    # its whole trace is already in the buffer
+                    p.ctx.emit("scatter", t_scatter, time.perf_counter(),
+                               model=self.name, rows=p.rows)
                 p.event.set()
         except BaseException as e:   # fail THIS batch, keep serving
             # fail exactly the requests not yet scattered — a scattered

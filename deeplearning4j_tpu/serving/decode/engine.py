@@ -31,6 +31,7 @@ stay — the continuous-batching isolation contract the tests assert.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -301,27 +302,39 @@ class DecodeEngine:
         return list(table) + [0] * (w - len(table))
 
     def run_prefill(self, v, pool: BlockPool, prompt: Sequence[int],
-                    table: Sequence[int]) -> np.ndarray:
+                    table: Sequence[int], ctx=None) -> np.ndarray:
         """Write `prompt`'s K/V through `table`, return the next-token
-        logits [V]. Batch 1: one compile per prompt bucket."""
+        logits [V]. Batch 1: one compile per prompt bucket. `ctx` is an
+        optional TraceContext — bucket_select + prefill child spans."""
         self._check_version(v)
         n = len(prompt)
+        t_sel = time.perf_counter()
         tb = self.prompt_bucket_for(n)
+        if ctx is not None:
+            ctx.emit("bucket_select", t_sel, time.perf_counter(),
+                     model=self.name, phase="prefill", bucket=tb, tokens=n)
         tokens = np.zeros((1, tb), np.int32)
         tokens[0, :n] = np.asarray(prompt, np.int32)
         exec_ = self.prefill_exec(v, tb)
+        t0 = time.perf_counter()
         pool.cache, logits = exec_(
             v.snapshot.data, pool.cache, jnp.asarray(tokens),
             jnp.asarray([n], jnp.int32),
             jnp.asarray([self._pad_table(table)], jnp.int32))
-        return np.asarray(logits)[0]
+        out = np.asarray(logits)[0]      # host sync: span covers real work
+        if ctx is not None:
+            ctx.emit("prefill", t0, time.perf_counter(),
+                     model=self.name, bucket=tb, tokens=n)
+        return out
 
     def run_tick(self, v, pool: BlockPool, tokens: Sequence[int],
                  positions: Sequence[int], tables: Sequence[Sequence[int]],
-                 bucket: int) -> np.ndarray:
+                 bucket: int, ctxs=None) -> np.ndarray:
         """One decode tick over `len(tokens)` live rows padded up to
         `bucket` (pad rows park at the trash block, length 1, and their
-        logits are discarded by the caller). Returns logits [rows, V]."""
+        logits are discarded by the caller). Returns logits [rows, V].
+        `ctxs` is an optional per-row TraceContext list — every traced
+        row gets a decode_tick child span for this shared step."""
         self._check_version(v)
         rows = len(tokens)
         if rows > bucket:
@@ -334,7 +347,16 @@ class DecodeEngine:
         for i, t in enumerate(tables):
             tab[i] = self._pad_table(t)
         exec_ = self.decode_exec(v, bucket)
+        t0 = time.perf_counter()
         pool.cache, logits = exec_(
             v.snapshot.data, pool.cache, jnp.asarray(tok),
             jnp.asarray(pos), jnp.asarray(tab))
-        return np.asarray(logits)[:rows]
+        out = np.asarray(logits)[:rows]  # host sync: span covers real work
+        if ctxs:
+            t1 = time.perf_counter()
+            for i, c in enumerate(ctxs[:rows]):
+                if c is not None:
+                    c.emit("decode_tick", t0, t1, model=self.name,
+                           bucket=bucket, rows=rows,
+                           position=int(positions[i]))
+        return out

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...telemetry.recorder import flight_recorder
 from ..batcher import FlushEma
 from ..registry import ServingError
 from .cache import OutOfBlocksError
@@ -50,9 +51,10 @@ class GenerationError(ServingError):
 class _Seq:
     __slots__ = ("sid", "ctx", "prompt_len", "max_tokens", "temperature",
                  "stop_ids", "rng", "blocks", "cached", "event", "result",
-                 "error")
+                 "error", "trace", "enqueued_at")
 
-    def __init__(self, sid, prompt, max_tokens, temperature, stop_ids, seed):
+    def __init__(self, sid, prompt, max_tokens, temperature, stop_ids, seed,
+                 trace=None):
         self.sid = sid
         self.ctx: List[int] = list(prompt)
         self.prompt_len = len(prompt)
@@ -65,6 +67,8 @@ class _Seq:
         self.event = threading.Event()
         self.result: Optional[Dict] = None
         self.error: Optional[Exception] = None
+        self.trace = trace              # TraceContext, or None
+        self.enqueued_at = time.perf_counter()
 
     @property
     def generated(self) -> List[int]:
@@ -130,7 +134,7 @@ class GenerationScheduler:
     def submit(self, prompt: Sequence[int], *, max_tokens: int = 16,
                temperature: float = 0.0, stop: Sequence[int] = (),
                seed: Optional[int] = None,
-               timeout: Optional[float] = None) -> Dict:
+               timeout: Optional[float] = None, ctx=None) -> Dict:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise GenerationError("prompt must be non-empty")
@@ -144,7 +148,8 @@ class GenerationScheduler:
             if self._closed:
                 raise GenerationError(f"{self.name}: scheduler is stopped")
             seq = _Seq(next(self._ids), prompt, int(max_tokens),
-                       float(temperature), [int(t) for t in stop], seed)
+                       float(temperature), [int(t) for t in stop], seed,
+                       trace=ctx)
             self._waiting.append(seq)
         self._wake.set()
         if not seq.event.wait(timeout):
@@ -165,11 +170,18 @@ class GenerationScheduler:
 
     # -- worker side -----------------------------------------------------
     def _finish(self, seq: _Seq, reason: str):
+        t0 = time.perf_counter()
         self.pool.release(seq.blocks)
         seq.blocks = []
         seq.result = {"tokens": seq.generated, "finish_reason": reason,
                       "prompt_tokens": seq.prompt_len,
                       "generated_tokens": len(seq.generated)}
+        if seq.trace is not None:
+            # emitted BEFORE event.set(): the waiter wakes to a complete
+            # trace (queue -> prefill -> ticks -> scatter) in the buffer
+            seq.trace.emit("scatter", t0, time.perf_counter(),
+                           model=self.name, finish_reason=reason,
+                           generated=len(seq.generated))
         seq.event.set()
 
     def _fail(self, seq: _Seq, err: Exception):
@@ -214,10 +226,16 @@ class GenerationScheduler:
         self.pool.release(victim.blocks)
         victim.blocks = []
         victim.cached = 0
+        victim.enqueued_at = time.perf_counter()   # re-queued: wait restarts
         with self._lock:
             self._waiting.appendleft(victim)
         if self._evict_c is not None:
             self._evict_c.inc(model=self.name)
+        rec = flight_recorder()
+        if rec.enabled:
+            rec.record("decode/evict", model=self.name, sid=victim.sid,
+                       kept_sid=keep.sid, ctx_len=len(victim.ctx),
+                       free_blocks=self.pool.free_blocks())
         return True
 
     def _reserve(self, seq: _Seq, n_tokens: int) -> bool:
@@ -241,11 +259,17 @@ class GenerationScheduler:
     def _flush_running(self):
         """Version swapped under us: preempt everything (sequences keep
         their ctx and re-prefill against the new weights)."""
+        rec = flight_recorder()
+        if rec.enabled and self._running:
+            rec.record("decode/swap_flush", model=self.name,
+                       preempted=len(self._running),
+                       free_blocks=self.pool.free_blocks())
         for seq in list(self._running):
             self._running.remove(seq)
             self.pool.release(seq.blocks)
             seq.blocks = []
             seq.cached = 0
+            seq.enqueued_at = time.perf_counter()
             with self._lock:
                 self._waiting.appendleft(seq)
 
@@ -261,9 +285,14 @@ class GenerationScheduler:
             if not self._reserve(seq, len(seq.ctx)):
                 continue
             t0 = time.perf_counter()
+            if seq.trace is not None:
+                # enqueue (or eviction re-queue) -> admission
+                seq.trace.emit("queue_wait", seq.enqueued_at, t0,
+                               model=self.name, sid=seq.sid,
+                               ctx_len=len(seq.ctx))
             try:
                 logits = self.engine.run_prefill(v, self.pool, seq.ctx,
-                                                 seq.blocks)
+                                                 seq.blocks, ctx=seq.trace)
             except Exception as e:          # noqa: BLE001 - fail the seq
                 self._fail(seq, e)
                 continue
@@ -272,6 +301,13 @@ class GenerationScheduler:
                                       model=self.name, phase="prefill")
             if self._admit_c is not None:
                 self._admit_c.inc(model=self.name)
+            rec = flight_recorder()
+            if rec.enabled:
+                # KV-pool pressure at the admission decision point
+                rec.record("decode/admit", model=self.name, sid=seq.sid,
+                           prompt_len=seq.prompt_len,
+                           blocks=len(seq.blocks),
+                           free_blocks=self.pool.free_blocks())
             seq.cached = len(seq.ctx)
             if not self._append_sample(seq, logits):
                 self._running.append(seq)
@@ -295,7 +331,8 @@ class GenerationScheduler:
         t0 = time.perf_counter()
         logits = self.engine.run_tick(
             v, self.pool, [s.ctx[s.cached] for s in batch],
-            [s.cached for s in batch], [s.blocks for s in batch], bucket)
+            [s.cached for s in batch], [s.blocks for s in batch], bucket,
+            ctxs=[s.trace for s in batch])
         dt = time.perf_counter() - t0
         self._ema.observe(bucket, dt)
         if self._phase_h is not None:

@@ -19,6 +19,17 @@ Endpoints
                                           "finish_reason": ..., ...}
     GET  /healthz                     -> {"status": "ok", "models": {...}}
     GET  /metrics                     -> Prometheus text (0.0.4)
+    GET  /debug/flightrecord          -> flight-recorder view: last guard
+                                         dump + the live event ring
+
+Tracing: every request gets a `TraceContext` (trace id + SLO tier from
+the `X-DL4J-SLO-Tier` header); the trace id comes back on EVERY
+response as the `X-DL4J-Trace` header and inside every structured error
+body, and the request's spans (root + queue_wait/bucket_select/prefill/
+decode_tick/scatter through the batching planes) land in the active
+telemetry session's Tracer as one connected Perfetto track. Latency is
+also observed per tier into the SLO surface (`dl4j_slo_latency_seconds`,
+`dl4j_slo_burn_rate`).
 
 Error semantics: 400 + {"error": ...} for client mistakes (malformed
 JSON, missing keys, shape mismatches, unknown precision), 404 for
@@ -38,6 +49,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.recorder import flight_recorder
+from ..telemetry.trace_context import DEFAULT_TIER, SloSurface, TraceContext
 from .batcher import BatcherClosedError, DynamicBatcher
 from .decode.scheduler import GenerationScheduler
 from .registry import (ModelRegistry, ServingError, UnknownModelError,
@@ -86,7 +99,8 @@ class InferenceServer:
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  batching: bool = True, max_wait_us: int = 2000,
-                 max_batch: Optional[int] = None):
+                 max_batch: Optional[int] = None,
+                 slo_targets: Optional[Dict[str, float]] = None):
         self.registry = registry if registry is not None else ModelRegistry()
         self.batching = bool(batching)
         self.max_wait_us = int(max_wait_us)
@@ -105,6 +119,7 @@ class InferenceServer:
             "dl4j_serving_latency_seconds",
             "request latency through the serving data plane (queue wait + "
             "forward) by path", labels=("model", "path"))
+        self.slo = SloSurface(m, targets=slo_targets)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
@@ -178,17 +193,17 @@ class InferenceServer:
 
     def generate(self, name: str, prompt, *, max_tokens: int = 16,
                  temperature: float = 0.0, stop=(), seed=None,
-                 timeout: Optional[float] = None) -> Dict:
+                 timeout: Optional[float] = None, ctx=None) -> Dict:
         self.registry.get(name)                     # -> 404 if unknown
         sched = self._schedulers.get(name)
         if sched is None:
             sched = self.enable_generation(name)
         return sched.submit(prompt, max_tokens=max_tokens,
                             temperature=temperature, stop=stop, seed=seed,
-                            timeout=timeout)
+                            timeout=timeout, ctx=ctx)
 
-    def predict(self, name: str, features, batched: Optional[bool] = None
-                ) -> Tuple[np.ndarray, int, str]:
+    def predict(self, name: str, features, batched: Optional[bool] = None,
+                ctx=None) -> Tuple[np.ndarray, int, str]:
         """(outputs, version, path) where path is 'batched' | 'direct'.
         Oversize requests (rows > largest bucket) always go direct — the
         direct path chunks; the batcher never splits a request."""
@@ -209,9 +224,14 @@ class InferenceServer:
                 path = "batched"
         with self._latency.time(model=name, path=path):
             if path == "batched":
-                out, version = batcher.submit(x)
+                out, version = batcher.submit(x, ctx=ctx)
             else:
-                out, version = self.registry.predict(name, x)
+                if ctx is not None:
+                    with ctx.span("direct_forward", model=name,
+                                  rows=int(x.shape[0])):
+                        out, version = self.registry.predict(name, x)
+                else:
+                    out, version = self.registry.predict(name, x)
         return out, version, path
 
     # -- HTTP plumbing ---------------------------------------------------
@@ -226,6 +246,12 @@ class InferenceServer:
 
             def _reply(self, code: int, payload, content_type=None,
                        endpoint="", model=""):
+                ctx = getattr(self, "_trace_ctx", None)
+                if (ctx is not None and isinstance(payload, dict)
+                        and "error" in payload):
+                    # every structured error body carries the trace id so
+                    # a client-side failure correlates with server spans
+                    payload = dict(payload, trace_id=ctx.trace_id)
                 if isinstance(payload, (dict, list)):
                     data = json.dumps(payload).encode()
                     content_type = content_type or "application/json"
@@ -233,9 +259,18 @@ class InferenceServer:
                     data = payload if isinstance(payload, bytes) \
                         else str(payload).encode()
                     content_type = content_type or "text/plain"
+                if ctx is not None:
+                    # root span + SLO observation land BEFORE the response
+                    # bytes: a client that reads the tracer the moment its
+                    # request returns always finds the connected trace
+                    ctx.emit_root(f"http/{endpoint or 'other'}",
+                                  code=code, model=model)
+                    srv.slo.observe(ctx.tier, ctx.elapsed())
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                if ctx is not None:
+                    self.send_header("X-DL4J-Trace", ctx.trace_id)
                 if code >= 400:
                     # error paths may not have consumed the request body;
                     # leaving it unread on an HTTP/1.1 keep-alive socket
@@ -249,11 +284,24 @@ class InferenceServer:
 
             def _dispatch(self, method: str):
                 endpoint, model = "other", ""
+                self._trace_ctx = ctx = TraceContext.begin(
+                    tier=self.headers.get("X-DL4J-SLO-Tier", DEFAULT_TIER))
                 try:
                     m = _MODEL_PATH.match(self.path)
                     if self.path == "/healthz" and method == "GET":
                         endpoint = "healthz"
                         self._reply(200, srv.health(), endpoint=endpoint)
+                    elif (self.path.partition("?")[0] == "/debug/flightrecord"
+                            and method == "GET"):
+                        endpoint = "flightrecord"
+                        rec = flight_recorder()
+                        self._reply(200,
+                                    {"enabled": rec.enabled,
+                                     "capacity": rec.capacity,
+                                     "total_events": rec.total_written(),
+                                     "last_dump": rec.last_dump,
+                                     "events": rec.snapshot()},
+                                    endpoint=endpoint)
                     elif self.path == "/metrics" and method == "GET":
                         endpoint = "metrics"
                         self._reply(
@@ -274,7 +322,7 @@ class InferenceServer:
                         body = parse_json_body(self)
                         out, version, path = srv.predict(
                             model, require(body, "features"),
-                            batched=body.get("batched"))
+                            batched=body.get("batched"), ctx=ctx)
                         self._reply(200, {"model": model,
                                           "version": version,
                                           "batched": path == "batched",
@@ -300,7 +348,7 @@ class InferenceServer:
                             res = srv.generate(
                                 model, prompt, max_tokens=max_tokens,
                                 temperature=temperature, stop=stop,
-                                seed=seed)
+                                seed=seed, ctx=ctx)
                         self._reply(200, dict(
                             model=model,
                             version=srv.registry.get(model).version, **res),

@@ -23,17 +23,29 @@ Four pieces:
 the process-wide session the instrumented hot paths consult.
 `TelemetryListener` (listener.py) wires per-iteration metrics into the
 existing listener chain without touching StatsListener/UI.
+
+Request-level observability (ISSUE 17):
+  * `TraceContext` / `SloSurface` (trace_context.py) — per-request
+    correlation ids threaded HTTP -> batcher -> decode scheduler/engine,
+    plus declared per-tier latency SLOs with burn-rate gauges.
+  * `FlightRecorder` (recorder.py) — always-on lock-free ring of
+    structured events; `fault/guard.py` dumps it on skip/rollback/halt
+    and the server exposes it at /debug/flightrecord.
 """
 from .compile_watch import CompileWatcher, watch_compiles
 from .listener import TelemetryListener
+from .recorder import FlightRecorder, flight_recorder, install
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, Timer)
 from .resources import ResourceWatermarks
 from .runtime import TelemetrySession, active, disable, enable, enabled
+from .trace_context import SloSurface, TraceContext
 from .tracing import Tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
     "Tracer", "CompileWatcher", "watch_compiles", "ResourceWatermarks",
     "TelemetrySession", "TelemetryListener",
+    "TraceContext", "SloSurface", "FlightRecorder", "flight_recorder",
+    "install",
     "active", "enable", "disable", "enabled",
 ]

@@ -1,0 +1,164 @@
+"""Observability overhead bench: the tracing + flight-recorder planes
+measured enabled-vs-disabled in ALTERNATING paired windows.
+
+ISSUE 17's contract is that always-on observability costs (almost)
+nothing: per-request ``TraceContext`` spans + SLO observation on the
+serving plane, and ``FlightRecorder`` events on the training plane, must
+keep paired throughput at >= 0.95 of the instrumented-off baseline.
+
+Two arms, each alternating OFF/ON windows within a pair (the repo's
+standard guard against sandbox load swings — a contaminated capture
+shows up as spread across pairs, and the median-of-ratios verdict
+ignores it):
+
+  * **serving** — closed-loop concurrent clients against the mlp128
+    batched data plane (``serving/bench._closed_loop``). The ON window
+    runs each request under a ``TraceContext`` (root span + the
+    queue_wait/batch_forward/scatter children the batcher emits into the
+    bounded Tracer) and feeds the SLO surface; the OFF window passes
+    ``ctx=None`` — the exact code path an untraced request takes.
+    Ratio = req_s_on / req_s_off.
+  * **fit** — the LeNet fit path under a ``TrainingGuard`` (the guard's
+    sanctioned host-sync already pays the score read in BOTH windows, so
+    the delta is purely the recorder). ON installs an enabled
+    ``FlightRecorder`` (train/step + train/window events), OFF an
+    ``enabled=False`` one whose ``record()`` is a single attribute check.
+    Ratio = t_off / t_on.
+
+The verdict is the median paired ratio per arm; ``pass_0p95`` is the
+gate bench.py's extras report (informational there — the obs CI target
+asserts it).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["run_obs_overhead_bench"]
+
+
+def _serving_arm(pairs: int, clients: int, requests: int) -> Dict:
+    from ..serving.bench import _closed_loop, _make_mlp128, _median
+    from ..serving.registry import ModelRegistry
+    from ..serving.server import InferenceServer
+    from . import enabled
+    from .trace_context import TraceContext
+
+    out: Dict = {"clients": clients, "requests_per_client": requests}
+    with enabled() as sess:
+        registry = ModelRegistry(buckets=(1, 8), metrics=sess.registry)
+        server = InferenceServer(registry, batching=True, max_wait_us=2000)
+        try:
+            registry.register("mlp128", _make_mlp128())
+            shape = registry.get("mlp128").example_shape
+
+            def make_row(i):
+                return np.random.default_rng(i).normal(
+                    size=(1,) + shape).astype(np.float32)
+
+            def plain(x):
+                server.predict("mlp128", x, batched=True)
+
+            def traced(x):
+                ctx = TraceContext.begin()
+                server.predict("mlp128", x, batched=True, ctx=ctx)
+                ctx.emit_root("bench/predict", model="mlp128")
+                server.slo.observe(ctx.tier, ctx.elapsed())
+
+            plain(make_row(0))
+            traced(make_row(0))
+            ratios, reps = [], []
+            for _ in range(pairs):
+                off = _closed_loop(plain, clients, requests, make_row)
+                on = _closed_loop(traced, clients, requests, make_row)
+                reps.append({"off": off, "on": on})
+                if off["req_s"]:
+                    ratios.append(round(on["req_s"] / off["req_s"], 3))
+        finally:
+            server.stop()
+    out["pairs"] = reps
+    out["paired_ratios"] = ratios
+    out["ratio"] = _median(ratios) if ratios else None
+    return out
+
+
+def _fit_arm(pairs: int, batch: int, n_batches: int) -> Dict:
+    from ..datasets.iterators import DataSet, ListDataSetIterator
+    from ..fault.guard import GuardPolicy, TrainingGuard
+    from ..models.zoo import lenet_mnist
+    from ..serving.bench import _median
+    from .recorder import FlightRecorder, flight_recorder, install
+
+    r = np.random.default_rng(0)
+    n = batch * n_batches
+    x = r.normal(size=(n, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, n)]
+    model = lenet_mnist(seed=7).init()
+    guard = TrainingGuard(GuardPolicy.WARN)
+
+    def one_fit():
+        it = ListDataSetIterator([DataSet(x, y)], batch_size=batch)
+        t0 = time.perf_counter()
+        model.fit(it, guard=guard)
+        return time.perf_counter() - t0
+
+    prev = flight_recorder()
+    out: Dict = {"batch": batch, "n_batches": n_batches}
+    try:
+        install(FlightRecorder(enabled=False))
+        one_fit()                      # compile + dispatch warmth
+        ratios, reps = [], []
+        for _ in range(pairs):
+            install(FlightRecorder(enabled=False))
+            t_off = one_fit()
+            install(FlightRecorder(enabled=True))
+            t_on = one_fit()
+            reps.append({"off_s": round(t_off, 4), "on_s": round(t_on, 4)})
+            if t_on > 0:
+                ratios.append(round(t_off / t_on, 3))
+    finally:
+        install(prev)
+    out["pairs"] = reps
+    out["paired_ratios"] = ratios
+    out["ratio"] = _median(ratios) if ratios else None
+    return out
+
+
+def run_obs_overhead_bench(pairs: int = 3, clients: int = 8,
+                           requests_per_client: int = 60,
+                           fit_batch: int = 128,
+                           fit_n_batches: int = 6) -> Dict:
+    """The ``Obs-overhead`` extras block: per-arm alternating paired
+    enabled/disabled windows, median paired ratio (>= 0.95 gate)."""
+    serving = _serving_arm(pairs, clients, requests_per_client)
+    fit = _fit_arm(pairs, fit_batch, fit_n_batches)
+    ratios = [r for r in (serving["ratio"], fit["ratio"]) if r is not None]
+    return {"serving": serving, "fit": fit,
+            "min_ratio": min(ratios) if ratios else None,
+            "pass_0p95": bool(ratios) and min(ratios) >= 0.95}
+
+
+def main(argv=None):
+    """`python -m deeplearning4j_tpu.telemetry.obs_bench` — one JSON
+    line."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.telemetry.obs_bench")
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--fit-batch", type=int, default=128)
+    ap.add_argument("--fit-batches", type=int, default=6)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_obs_overhead_bench(
+        pairs=args.pairs, clients=args.clients,
+        requests_per_client=args.requests, fit_batch=args.fit_batch,
+        fit_n_batches=args.fit_batches)))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,14 @@ class _Span:
         return False
 
 
+# counter tracks get synthetic tids from this base so each counter name
+# renders as its own named row instead of all interleaving on tid 0
+# (which also carries the process_name metadata). Real thread idents are
+# pthread pointers (Linux) or small handles (Windows); a dedicated
+# 2^31-aligned range collides with neither in practice.
+_COUNTER_TID_BASE = 0x80000000
+
+
 class Tracer:
     def __init__(self, max_events: int = 200_000,
                  process_name: str = "deeplearning4j_tpu"):
@@ -43,6 +51,7 @@ class Tracer:
         self._max_events = int(max_events)
         self.dropped_events = 0
         self._pid = os.getpid()
+        self._counter_tids: Dict[str, int] = {}
         self._append({"ph": "M", "name": "process_name", "pid": self._pid,
                       "tid": 0, "args": {"name": process_name}})
 
@@ -77,11 +86,34 @@ class Tracer:
             ev["args"] = args
         self._append(ev)
 
+    def _counter_tid(self, name: str) -> int:
+        """Stable synthetic tid per counter name, with a one-time
+        thread_name metadata event naming the row."""
+        tid = self._counter_tids.get(name)   # GIL-atomic fast path
+        if tid is not None:
+            return tid
+        with self._lock:
+            tid = self._counter_tids.get(name)
+            if tid is None:
+                tid = _COUNTER_TID_BASE + len(self._counter_tids)
+                self._counter_tids[name] = tid
+                meta = True
+            else:
+                meta = False
+        if meta:
+            self._append({"ph": "M", "name": "thread_name",
+                          "pid": self._pid, "tid": tid,
+                          "args": {"name": f"counter:{name}"}})
+        return tid
+
     def counter(self, name: str, **series):
-        """Chrome counter-track event (rendered as a stacked area chart)."""
+        """Chrome counter-track event (rendered as a stacked area chart)
+        on its own named row — KV-pool and queue-depth counters no
+        longer interleave on tid 0."""
         self._append({"ph": "C", "name": name, "cat": "runtime",
                       "ts": round(self._us(time.perf_counter()), 3),
-                      "pid": self._pid, "tid": 0, "args": series})
+                      "pid": self._pid, "tid": self._counter_tid(name),
+                      "args": series})
 
     def __len__(self):
         with self._lock:

@@ -59,6 +59,14 @@
 #                            the TP-only / DP×TP / ZERO1×TP paired arms
 #                            (per-device bytes + per-axis collective
 #                            payloads JSON)
+#   ./runtests.sh obs        observability smoke: the ISSUE 17 suite
+#                            (connected /generate trace, Tracer
+#                            saturation accounting, flight-recorder ring
+#                            + guard-trip dumps, SLO surface,
+#                            /debug/flightrecord) plus one paired
+#                            enabled-vs-disabled obs-overhead bench rep
+#                            (serving + LeNet fit arms; the >=0.95
+#                            paired-ratio gate)
 #   ./runtests.sh lint       graftlint, both tiers: the AST pass
 #                            (jit/tracer hygiene, recompile hazards,
 #                            donation safety, concurrency lint) AND the
@@ -132,6 +140,14 @@ fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="
     exec python -m pytest tests/test_fault.py -q
+fi
+if [[ "${1:-}" == "obs" ]]; then
+    echo "=== observability smoke ==="
+    python -m pytest tests/test_observability.py -q
+    echo "=== paired enabled-vs-disabled obs-overhead bench rep ==="
+    exec env JAX_PLATFORMS=cpu \
+        python -m deeplearning4j_tpu.telemetry.obs_bench \
+        --pairs 2 --clients 4 --requests 40 --fit-batches 4
 fi
 if [[ "${1:-}" == "telemetry" ]]; then
     echo "=== telemetry smoke ==="
