@@ -132,6 +132,30 @@ def bench_mesh2d(devices=8):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_flash(devices=8):
+    """Flash-under-SPMD ablation (ISSUE 18): the transformer LM trained
+    ZERO1×TP on the (2,4) mesh with the shard_map'd Pallas kernel forced
+    on vs the einsum fallback, plus bf16-compute vs fp32, in alternating
+    paired windows — and the remat-policy activation-bytes column from
+    the 1F1B stage's static accounting (gate: `dots` saves >= 25% less
+    than the un-checkpointed `everything` set). Wall-clock of the flash
+    arm is interpret-mode emulation on the CPU mesh (documented caveat);
+    the kernel-presence and reshard-byte claims ride the IR lint."""
+    from deeplearning4j_tpu.util.platform import (
+        child_env_with_virtual_devices)
+
+    env = child_env_with_virtual_devices(devices)
+    out = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.scaling_bench",
+         "--devices", str(devices), "--mode", "flash", "--steps", "2",
+         "--reps", "2"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=2700)
+    if out.returncode != 0:
+        return None
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_pipeline(devices=8):
     """GPipe bubble-fraction characterization across microbatch counts at
     S=4 on the virtual mesh (BASELINE row 6; ratios are load-robust)."""
@@ -370,6 +394,24 @@ def main():
                 "data_axis_declared_vs_measured": m2.get(
                     "data_axis_declared_vs_measured"),
                 "gate": m2.get("gate")}
+    except Exception:
+        pass
+    try:
+        # flash-under-SPMD (ISSUE 18): shard_map'd Pallas attention vs
+        # einsum and bf16 vs fp32 in paired windows, plus the selective-
+        # remat activation-bytes column and its reduction gate
+        fl = bench_flash(8)
+        if fl:
+            extras["Flash-spmd-tokens-per-s"] = {
+                "arms": fl["arms"],
+                "flash_vs_einsum_paired": fl.get("flash_vs_einsum_paired"),
+                "flash_vs_einsum_spread": fl.get("flash_vs_einsum_spread"),
+                "bf16_vs_fp32_paired": fl.get("bf16_vs_fp32_paired"),
+                "bf16_vs_fp32_spread": fl.get("bf16_vs_fp32_spread"),
+                "remat_policy_saved_bytes": fl.get(
+                    "remat_policy_saved_bytes"),
+                "wall_clock_caveat": fl.get("wall_clock_caveat"),
+                "gate": fl.get("gate")}
     except Exception:
         pass
     try:

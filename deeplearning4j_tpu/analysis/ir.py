@@ -50,6 +50,11 @@ through the same pragma/baseline/ratchet machinery, under the
                              collective combiner may merge/reorder them,
                              so the summed gradients are not stable
                              across schedules or elastic resizes
+  ir-missing-custom-call     an entry declaring the shard_map'd Pallas
+                             kernel path (expects_custom_call) whose
+                             traced program carries no pallas_call
+                             primitive — the kernel silently fell back
+                             to the XLA path
 
 The order check has a runtime counterpart
 (`analysis.sanitizer.CollectiveSequenceHasher`): the static pass digests
@@ -103,6 +108,10 @@ IR_RULES = {
     "ir-nondeterministic-reduction": ("ir-determinism", "bit-exact entry "
                                       "issues unordered float reductions "
                                       "XLA may reassociate"),
+    "ir-missing-custom-call": ("ir-kernel", "entry declares a Pallas "
+                               "kernel path but the traced program "
+                               "carries no pallas_call — the kernel was "
+                               "silently replaced by the XLA fallback"),
 }
 for _rid, (_fam, _desc) in IR_RULES.items():
     register_rule_id(_rid, _fam, _desc)
@@ -137,6 +146,14 @@ class IrEntry:
     expected_constraints: Optional[int] = None
     requires_ordered_reductions: bool = False
     asserts_bitexact: bool = False
+    # flash-under-SPMD entries (ISSUE 18): the step is built around the
+    # shard_map'd Pallas kernel, so the traced jaxpr must carry a
+    # pallas_call primitive (inside the shard_map body — _walk_eqns
+    # descends it). Checked at the jaxpr level: it is backend-portable
+    # (interpret-mode tracing emits the same primitive the TPU lowering
+    # turns into the custom call), where compiled-HLO custom-call text
+    # only exists on a real TPU.
+    expects_custom_call: bool = False
     byte_slack: float = 1.5                # CPU emulates reduce-scatter as
                                            # full all-reduce; 1.5x + 1KiB
                                            # absorbs that plus scalar sums
@@ -597,6 +614,19 @@ def analyze_entry(entry: IrEntry) -> List[Finding]:
                 f"{entry.expected_constraints} — a with_sharding_"
                 "constraint was dropped; XLA propagation is now free to "
                 "replicate the shard", "constraints"))
+
+    # -- missing-custom-call ----------------------------------------------
+    if entry.expects_custom_call and jaxpr is not None:
+        calls = count_primitives(jaxpr, "pallas_call")
+        if calls == 0:
+            findings.append(entry.finding(
+                "ir-missing-custom-call",
+                "entry declares the shard_map'd Pallas kernel path but "
+                "the traced program carries no pallas_call primitive — "
+                "the kernel was dropped and the step silently runs the "
+                "XLA fallback (the einsum path should be selected "
+                "EXPLICITLY via configure_flash_attention, not by "
+                "losing the kernel)", "custom-call"))
 
     # -- ineffective-donation ---------------------------------------------
     if stablehlo:

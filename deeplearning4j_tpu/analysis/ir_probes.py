@@ -29,9 +29,9 @@ from .ir import IrEntry
 
 __all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
            "parallel_entries", "zero_accum_entry", "mesh2d_entries",
-           "mesh2d_zero1_tp_entry", "pp_entry", "pp_entries",
-           "serving_entries", "decode_entry", "decode_entries",
-           "virtual_mesh"]
+           "mesh2d_zero1_tp_entry", "flash_spmd_entry", "flash_entries",
+           "pp_entry", "pp_entries", "serving_entries", "decode_entry",
+           "decode_entries", "virtual_mesh"]
 
 
 def virtual_mesh():
@@ -405,6 +405,79 @@ def mesh2d_entries() -> List[IrEntry]:
     return entries
 
 
+def _flash_arm(shape: Tuple[int, int], flash):
+    """Build the ZERO1×TP transformer-LM trainer with the attention mode
+    FORCED (``flash="spmd"`` -> shard_map'd Pallas kernel, interpret mode
+    on the CPU mesh; ``flash=False`` -> the einsum reference) and return
+    the jitted step fn plus its args. Both arms share the model, mesh and
+    batch so their compiled texts differ only in the attention body."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.scaling_bench import _build_transformer_lm
+    from ..parallel.trainer import ParallelTrainer, ShardingStrategy
+
+    vocab, seq, b = 32, 8, 8
+    tr = ParallelTrainer(_build_transformer_lm(vocab, 16, 4, 1, seq),
+                         mesh_shape=shape,
+                         strategy=ShardingStrategy.ZERO1_TP, flash=flash)
+    r = np.random.default_rng(0)
+    x = r.integers(0, vocab, (b, seq, 1)).astype(np.float32)
+    y = np.eye(vocab, dtype=np.float32)[r.integers(0, vocab, (b, seq))]
+    args = (tr._params, tr._state, tr._opt, jnp.asarray(0, jnp.int32),
+            x, y, jax.random.PRNGKey(0), None, None)
+    return tr._step_fn.__wrapped__, args, tuple(tr.mesh.axis_names)
+
+
+def flash_spmd_entry(shape: Tuple[int, int] = (2, 4),
+                     budgets: Optional[dict] = None,
+                     mutate: Optional[str] = None) -> IrEntry:
+    """The flash-attention ZERO1×TP train step: the shard_map'd Pallas
+    kernel must SURVIVE into the traced program (`expects_custom_call` —
+    a silent einsum fallback is a perf regression, not an error) and its
+    per-axis collective bytes must stay inside the paired einsum arm's
+    measured budgets (the kernel is per-shard local, so it may remove
+    attention collectives but never add reshard traffic). Public so tests
+    can seed the mutation through the same builder:
+
+      mutate="drop_flash"  the step body is the einsum fallback while the
+                           entry still declares the kernel contract — the
+                           jaxpr carries no pallas_call and
+                           `ir-missing-custom-call` fires
+    """
+    if mutate not in (None, "drop_flash"):
+        raise ValueError(f"unknown mutation {mutate!r}")
+    d, m = shape
+    fn, args, axes = _flash_arm(
+        shape, False if mutate == "drop_flash" else "spmd")
+    entry = IrEntry(
+        f"parallel/flash_spmd_step_{d}x{m}", "kernels/attention.py",
+        fn=fn, args=args, mesh_axes=axes, expects_custom_call=True)
+    if budgets is not None:
+        entry.axis_sizes = {"data": d, "model": m}
+        entry.declared_bytes_by_axis = dict(budgets)
+    return entry
+
+
+def flash_entries() -> List[IrEntry]:
+    """The flash-under-SPMD pair (ISSUE 18): compile the EINSUM arm of
+    the same ZERO1×TP transformer-LM step first and measure its per-axis
+    collective payloads; those measurements become the flash entry's
+    budgets on every bucket (data, model, other), so any reshard byte the
+    shard_map'd kernel adds over the fallback is a finding."""
+    from ..analysis.ir import measured_collective_bytes_by_axis
+
+    shape = (2, 4)
+    fn, args, _ = _flash_arm(shape, False)
+    text = fn.trace(*args).lower().compile().as_text()
+    by_axis = measured_collective_bytes_by_axis(
+        text, {"data": shape[0], "model": shape[1]})
+    budgets = {ax: sum(by_axis.get(ax, {}).values())
+               for ax in ("data", "model", "other")}
+    return [flash_spmd_entry(shape, budgets=budgets)]
+
+
 def _pp_stack_model(depth: int, hidden: int = 8, seed: int = 0):
     """Uniform Dense(hidden->hidden) stack + softmax head: the minimal
     homogeneous-run model the PipelinePlan stages (input width == hidden
@@ -664,6 +737,7 @@ def build_entries() -> List[IrEntry]:
     entries.append(zero_accum_entry())
     entries += pp_entries()
     entries += mesh2d_entries()
+    entries += flash_entries()
     entries += serving_entries()
     entries += decode_entries()
     return entries

@@ -278,6 +278,27 @@ class FitCheckpointer:
                 "part of the training MATH (unlike superstep grouping), "
                 "so the resumed run will not match the uninterrupted one",
                 cur_m, stored_m)
+        # precision/remat policy mismatches (ISSUE 18): compute_dtype
+        # changes the training math; remat/remat_policy only the
+        # memory/recompute profile (numerics no-ops) — warn accordingly
+        stored_cdt = meta.get("compute_dtype")
+        cur_cdt = self.context.get("compute_dtype")
+        if ("compute_dtype" in meta and "compute_dtype" in self.context
+                and stored_cdt != cur_cdt):
+            log.warning(
+                "resuming with compute_dtype=%s but the checkpoint was "
+                "written with compute_dtype=%s — the compute precision is "
+                "part of the training MATH, so the resumed run will not "
+                "match the uninterrupted one", cur_cdt, stored_cdt)
+        for key in ("remat", "remat_policy"):
+            if key in meta and key in self.context \
+                    and meta.get(key) != self.context.get(key):
+                log.warning(
+                    "resuming with %s=%s but the checkpoint was written "
+                    "with %s=%s — rematerialization is a numerics no-op "
+                    "(memory/recompute profile only), training math is "
+                    "unchanged", key, self.context.get(key), key,
+                    meta.get(key))
         done = int(meta.get("epoch_in_fit", 0))
         skip = int(meta.get("batches_into_epoch", 0))
         self._epoch_in_fit = done

@@ -15,8 +15,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["pallas_supported", "flash_attention", "fused_bn_relu",
-           "bn_relu_inference"]
+__all__ = ["pallas_supported", "flash_attention", "flash_attention_spmd",
+           "fused_bn_relu", "bn_relu_inference"]
 
 
 def pallas_supported() -> bool:
@@ -30,5 +30,5 @@ def pallas_supported() -> bool:
     return jax.default_backend() == "tpu"
 
 
-from .attention import flash_attention                      # noqa: E402
+from .attention import flash_attention, flash_attention_spmd  # noqa: E402
 from .bn_relu import bn_relu_inference, fused_bn_relu      # noqa: E402

@@ -96,6 +96,13 @@ class NeuralNetConfiguration:
     # residual nets this lands on block boundaries). Trades recompute FLOPs
     # for saved-activation HBM footprint/traffic.
     remat: Optional[str] = None
+    # Selective rematerialization: what each checkpoint boundary SAVES —
+    # a nn/remat.py policy name ("nothing" | "dots" | "dots_no_batch" |
+    # "everything"); None = jax's save-nothing default. Orthogonal to
+    # `remat` (which decides WHERE boundaries go); inherited per-layer
+    # unless the layer overrides. Numerics no-op (recompute-for-memory
+    # trade only).
+    remat_policy: Optional[str] = None
 
     @staticmethod
     def builder() -> "NeuralNetConfigurationBuilder":
@@ -129,6 +136,8 @@ class NeuralNetConfiguration:
         if (layer.activation_store_dtype is None
                 and self.activation_store_dtype is not None):
             ov["activation_store_dtype"] = self.activation_store_dtype
+        if layer.remat_policy is None and self.remat_policy is not None:
+            ov["remat_policy"] = self.remat_policy
         if layer.gradient_normalization is None:
             ov["gradient_normalization"] = self.gradient_normalization
         if layer.gradient_normalization_threshold is None:
@@ -242,6 +251,16 @@ class NeuralNetConfigurationBuilder:
         if mode is not None and mode not in ("full", "layer", "blocks"):
             raise ValueError(f"remat must be None|'full'|'layer'|'blocks', got {mode!r}")
         self._c.remat = mode; return self
+
+    def remat_policy(self, name):
+        """Selective remat: what each checkpoint boundary saves — None
+        (jax's save-nothing default) or a `nn/remat.py` policy name:
+        "nothing" | "dots" | "dots_no_batch" | "everything". Orthogonal
+        to `.remat(mode)` (where the boundaries go); a numerics no-op
+        that trades activation memory for recompute."""
+        from ..remat import resolve_policy
+        resolve_policy(name)          # fail fast on a typo
+        self._c.remat_policy = name; return self
 
     def build(self) -> NeuralNetConfiguration:
         return self._c

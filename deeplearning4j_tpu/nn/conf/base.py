@@ -186,6 +186,12 @@ class LayerConf:
     # gradient precision). Consumed by conv/BN layers; None = save in the
     # compute dtype (exact).
     activation_store_dtype: Optional[str] = None
+    # Selective rematerialization: what each jax.checkpoint boundary
+    # around this layer SAVES — a nn/remat.py policy name ("nothing",
+    # "dots", "dots_no_batch", "everything"); None inherits the global
+    # remat_policy (jax's save-nothing default when that is None too).
+    # Numerics no-op: trades activation memory for recompute only.
+    remat_policy: Optional[str] = None
 
     # ---- shape inference -------------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:

@@ -113,6 +113,13 @@ class ComputationGraph:
             return None
         return jnp.dtype(cdt)
 
+    def _precision_remat_context(self):
+        """FitCheckpointer context entries (see MultiLayerNetwork) — the
+        policies whose mismatch a resume should warn about."""
+        c = self.conf.conf
+        return {"compute_dtype": c.compute_dtype, "remat": c.remat,
+                "remat_policy": c.remat_policy}
+
     # ------------------------------------------------------------------
     # Functional core
     # ------------------------------------------------------------------
@@ -276,8 +283,11 @@ class ComputationGraph:
                                        out_set, None)
                 return {n: vals[n] for n in _keep}, ns
 
-            res, ns = jax.checkpoint(seg_fn)(boundary, seg_params, seg_state,
-                                             seg_rngs)
+            from .remat import resolve_policy
+            res, ns = jax.checkpoint(
+                seg_fn,
+                policy=resolve_policy(self.conf.conf.remat_policy))(
+                    boundary, seg_params, seg_state, seg_rngs)
             values.update(res)
             masks.update({n: None for n in res})
             new_state.update(ns)
@@ -341,11 +351,15 @@ class ComputationGraph:
         if self.conf.conf.remat == "full":
             # save only the step inputs; recompute the entire forward in
             # backward (jax.checkpoint over the whole loss)
+            from .remat import resolve_policy
+            pol = resolve_policy(self.conf.conf.remat_policy)
+
             def loss_fn(params, state, inputs, labels, rng,
                         fmasks=None, lmasks=None):
                 f = lambda p, s, i_, l_, r_: base_loss(
                     p, s, i_, l_, r_, fmasks=fmasks, lmasks=lmasks)
-                return jax.checkpoint(f)(params, state, inputs, labels, rng)
+                return jax.checkpoint(f, policy=pol)(params, state, inputs,
+                                                     labels, rng)
         else:
             loss_fn = base_loss
 
@@ -422,11 +436,15 @@ class ComputationGraph:
         accumulation superstep and the ZeRO step)."""
         base_loss = self._loss_fn
         if self.conf.conf.remat == "full":
+            from .remat import resolve_policy
+            pol = resolve_policy(self.conf.conf.remat_policy)
+
             def loss_fn(params, state, inputs, labels, rng,
                         fmasks=None, lmasks=None):
                 f = lambda p, s, i_, l_, r_: base_loss(
                     p, s, i_, l_, r_, fmasks=fmasks, lmasks=lmasks)
-                return jax.checkpoint(f)(params, state, inputs, labels, rng)
+                return jax.checkpoint(f, policy=pol)(params, state, inputs,
+                                                     labels, rng)
         else:
             loss_fn = base_loss
         minimize = self.conf.conf.minimize

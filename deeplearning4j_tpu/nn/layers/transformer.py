@@ -24,9 +24,13 @@ instead of hand-written collectives.
 
 Attention itself reuses `kernels/attention.py`: the Pallas flash kernel
 (full custom-VJP backward) vmapped over the head axis on TPU, the
-einsum `attention_reference` elsewhere (GSPMD shards plain einsums
-cleanly; Pallas custom calls cannot be auto-partitioned, so the kernel
-path is for replicated/single-device runs — `flash="auto"` picks).
+einsum `attention_reference` elsewhere. Pallas custom calls cannot be
+auto-partitioned by GSPMD, so inside a trainer-managed sharded step the
+kernel rides `flash_attention_spmd` — the same kernel under `shard_map`
+over (data, model); the Megatron head sharding makes each shard's local
+[B/d, T, H/m, Dh] block a standalone attention problem (`flash="spmd"`,
+set by `parallel/trainer.py:configure_flash_attention`). `flash="auto"`
+picks kernel-vs-einsum for replicated/single-device runs.
 """
 from __future__ import annotations
 
@@ -57,11 +61,17 @@ class TransformerBlock(LayerConf):
     default (GPT-style LM). `flash` selects the attention implementation:
     True = `kernels.attention.flash_attention` (Pallas, vmapped over
     heads), False = `kernels.attention.attention_reference` (einsum),
-    "auto" = flash on the TPU backend, reference elsewhere. The einsum
-    path is the one GSPMD can partition over a mesh — GSPMD has no rule
-    for a Pallas custom call — so ParallelTrainer pins `flash = False`
-    (instance attr) on every block it manages; "auto" is for
-    standalone/single-device models.
+    "auto" = flash on the TPU backend, reference elsewhere, and
+    "spmd" = `kernels.attention.flash_attention_spmd` — the kernel under
+    `shard_map` over the (data, model) mesh recorded in `flash_spmd`
+    (an instance attr `(mesh, data_axis, model_axis)` the trainer's
+    capability probe sets alongside the mode). GSPMD has no partitioning
+    rule for a Pallas custom call, so inside a trainer-managed sharded
+    jit the kernel must either run per-shard via shard_map ("spmd" —
+    the Megatron head sharding makes each local block a standalone
+    attention problem, zero collectives) or give way to the einsum path
+    (False); `parallel/trainer.py:configure_flash_attention` picks per
+    backend/mesh. "auto" is for standalone/single-device models.
     """
 
     input_kind = "rnn"
@@ -71,6 +81,7 @@ class TransformerBlock(LayerConf):
     ffn_mult: int = 4           # FFN hidden = ffn_mult * n_model
     causal: bool = True
     flash = "auto"              # class attr: not part of the config JSON
+    flash_spmd = None           # (mesh, data_axis, model_axis) for "spmd"
 
     # Megatron tensor-parallel roles (see parallel/sharding.py):
     # axis index to shard over ``model``, or "replicated"
@@ -172,6 +183,15 @@ class TransformerBlock(LayerConf):
             w = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhts,bshd->bthd", w, v.astype(jnp.float32))
             return out.astype(q.dtype)
+        if self.flash == "spmd":
+            # trainer-managed sharded jit: run the kernel per-shard via
+            # shard_map (configure_flash_attention set flash_spmd)
+            from ...kernels.attention import flash_attention_spmd
+
+            mesh, data_axis, model_axis = self.flash_spmd
+            return flash_attention_spmd(
+                q, k, v, self.causal, mesh=mesh,
+                data_axis=data_axis, model_axis=model_axis)
         fn = flash_attention if self._use_flash() else attention_reference
         # [B, T, H, Dh]: map the kernel ([B, T, D] contract) over heads
         return jax.vmap(fn, in_axes=(2, 2, 2, None), out_axes=2)(

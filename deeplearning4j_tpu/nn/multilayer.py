@@ -33,6 +33,7 @@ from .conf import (BackpropType, MultiLayerConfiguration,
                    NeuralNetConfiguration, OptimizationAlgorithm)
 from .conf.base import LayerConf, cast_floating
 from .gradnorm import apply_gradient_normalization
+from .remat import resolve_policy
 from .layers.feedforward import BaseOutputLayerConf
 from ..datasets.iterators import ArrayDataSetIterator, DataSet, DataSetIterator
 from ..eval.evaluation import Evaluation
@@ -162,6 +163,15 @@ class MultiLayerNetwork:
             return None
         return jnp.dtype(cdt)
 
+    def _precision_remat_context(self):
+        """FitCheckpointer context entries for the policies that shape the
+        step's math/memory (ISSUE 18): resume warns when the restored
+        run's values differ (compute_dtype changes the math; remat /
+        remat_policy only the memory profile)."""
+        c = self.conf.conf
+        return {"compute_dtype": c.compute_dtype, "remat": c.remat,
+                "remat_policy": c.remat_policy}
+
     # ------------------------------------------------------------------
     # Pure functional core (closed over static layer configs)
     # ------------------------------------------------------------------
@@ -211,8 +221,11 @@ class MultiLayerNetwork:
                     and not isinstance(layer, BaseOutputLayerConf)):
                 fn = lambda p_, s_, x_, r_, _l=layer: _l.apply(
                     p_, s_, x_, train=train, rng=r_, mask=None)
-                x, new_state[i] = jax.checkpoint(fn)(p_i, state[i], x,
-                                                     rngs[i])
+                # per-layer selective remat: the layer's (inherited)
+                # policy decides what this boundary saves
+                x, new_state[i] = jax.checkpoint(
+                    fn, policy=resolve_policy(layer.remat_policy))(
+                        p_i, state[i], x, rngs[i])
             else:
                 x, new_state[i] = layer.apply(p_i, state[i], x,
                                               train=train, rng=rngs[i],
@@ -317,12 +330,15 @@ class MultiLayerNetwork:
         if self.conf.conf.remat == "full":
             # save only the step inputs; recompute the entire forward in
             # backward (jax.checkpoint over the whole loss)
+            pol = resolve_policy(self.conf.conf.remat_policy)
+
             def loss_fn(params, state, x, y, rng, fmask=None, lmask=None,
                         carries=None):
                 f = lambda p, s, x_, y_, r_: base_loss(
                     p, s, x_, y_, r_, fmask=fmask, lmask=lmask,
                     carries=carries)
-                return jax.checkpoint(f)(params, state, x, y, rng)
+                return jax.checkpoint(f, policy=pol)(params, state, x, y,
+                                                     rng)
         else:
             loss_fn = base_loss
 
@@ -362,10 +378,13 @@ class MultiLayerNetwork:
         reduction/update schedule (nn/superstep.py, parallel/zero.py)."""
         base_loss = self._loss_fn
         if self.conf.conf.remat == "full":
+            pol = resolve_policy(self.conf.conf.remat_policy)
+
             def loss_fn(params, state, x, y, rng, fmask=None, lmask=None):
                 f = lambda p, s, x_, y_, r_: base_loss(
                     p, s, x_, y_, r_, fmask=fmask, lmask=lmask)
-                return jax.checkpoint(f)(params, state, x, y, rng)
+                return jax.checkpoint(f, policy=pol)(params, state, x, y,
+                                                     rng)
         else:
             loss_fn = base_loss
         minimize = self.conf.conf.minimize
@@ -580,9 +599,10 @@ class MultiLayerNetwork:
                     "would take effect")
             return self
         from ..fault.resume import maybe_fit_checkpointer
-        ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
-                                      resume,
-                                      context={"grad_accumulation": accum_m})
+        ckpt = maybe_fit_checkpointer(
+            self, checkpoint_dir, checkpoint_every, resume,
+            context={"grad_accumulation": accum_m,
+                     **self._precision_remat_context()})
         skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,
@@ -833,7 +853,8 @@ class MultiLayerNetwork:
             warn_scan_replay(self.listeners)
         from ..fault.resume import maybe_fit_checkpointer
         ckpt = maybe_fit_checkpointer(self, checkpoint_dir, checkpoint_every,
-                                      resume)
+                                      resume,
+                                      context=self._precision_remat_context())
         done_epochs = (ckpt.resume_into()[1] if ckpt is not None else 0)
         with (ckpt.sigterm_snapshot() if ckpt is not None else _null_span()):
             for _ in range(max(0, epochs - done_epochs)):

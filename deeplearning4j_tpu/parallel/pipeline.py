@@ -18,8 +18,11 @@ compiled program: warmup / steady interleaved forward-backward / cooldown
 with bubble fraction (S-1)/(M+S-1) — the non-interleaved 1F1B number —
 at ONE XLA dispatch per optimizer step instead of the host-driven
 O(stages·microbatches) storm below. Stage activation residuals are
-rematerialized per tick (`jax.checkpoint` on the stage body), bounding
-what the backward holds live. Composed into `ParallelTrainer` as
+rematerialized per tick (`jax.checkpoint` on the stage body; the saved
+set is policy-selectable via `remat_policy`, accounted by
+`pp_stage_saved_bytes`), bounding what the backward holds live. The step
+honors `compute_dtype` mixed precision with the same bf16-compute/
+fp32-master semantics as every other fit path. Composed into `ParallelTrainer` as
 `strategy="pp"` (pure pipe) and `"zero1_tp_pp"` (ZeRO-1 moments over
 `data` × Megatron TP over `model` × 1F1B over `pipe`).
 
@@ -49,7 +52,8 @@ from ..telemetry.compile_watch import watch_compiles
 
 __all__ = ["pipeline_forward", "PipelinedDenseStack",
            "PipelinedNetworkTrainer", "PipelinedGraphTrainer",
-           "PipelinePlan", "make_pp_step", "make_pp_accum_superstep"]
+           "PipelinePlan", "make_pp_step", "make_pp_accum_superstep",
+           "pp_stage_saved_bytes"]
 
 
 # ===========================================================================
@@ -122,11 +126,6 @@ class PipelinePlan:
                 "GPipe) or a chain model")
         if model.params is None:
             model.init()
-        if model._compute_dtype is not None:
-            raise ValueError(
-                "the 1F1B step does not support compute_dtype mixed "
-                "precision yet — drop compute_dtype or use "
-                "strategy='pipeline'")
         layers = model.layers
         n = len(layers)
         if n < 2 or not isinstance(layers[-1], BaseOutputLayerConf):
@@ -356,6 +355,60 @@ class PipelinePlan:
 PP_CONSTRAINT_SITES = 5
 
 
+def _stage_body(plan: "PipelinePlan", cdt=None):
+    """ONE stage's v-layer forward (vmapped over the stage axis and
+    wrapped in the policy-aware jax.checkpoint by the caller). Factored
+    out of `_pp_loss_fn` so `pp_stage_saved_bytes` measures EXACTLY the
+    body the step checkpoints. `cdt` = mixed-precision compute dtype:
+    slot params are cast per tick (stage layers are never output
+    layers, so the cast covers every slot)."""
+    from ..nn.conf.base import cast_floating
+
+    layers, lo, v = plan.model.layers, plan.lo, plan.slots
+
+    def stage_apply(slot_params, slot_states, x, keys):
+        new_states = []
+        for r in range(v):
+            p_r = (slot_params[r] if cdt is None
+                   else cast_floating(slot_params[r], cdt))
+            x, s_r = layers[lo + r].apply(
+                p_r, slot_states[r], x, train=True,
+                rng=keys[r], mask=None)
+            new_states.append(s_r)
+        return x, tuple(new_states)
+
+    return stage_apply
+
+
+def pp_stage_saved_bytes(plan: "PipelinePlan", micro_shape,
+                         policy: Optional[str] = None) -> int:
+    """Static activation-byte accounting for the 1F1B stage checkpoint
+    (the `_ZeroPlan.info` counterpart for rematerialization): bytes of
+    intermediate residuals ONE ring tick's checkpointed stage body saves
+    for backward under the named `nn/remat.py` policy, for a stage-entry
+    activation of shape `micro_shape` (microbatch rows first, NO stage
+    axis — e.g. ``(mb, T, width)`` for the transformer LM). policy=None
+    is the blanket save-nothing boundary (0 by construction);
+    policy="everything" is what an UN-checkpointed stage would hold —
+    the baseline the selective policies are measured against. Pure
+    trace-time accounting: nothing is executed on device."""
+    from ..nn.remat import saved_bytes
+
+    m = plan.model
+    S, v = plan.n_stages, plan.slots
+    cdt = m._compute_dtype
+    zeros = lambda t: jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), t)
+    params_stack = zeros(plan.stack(m.params)["stack"])
+    state_stack = zeros(plan.stack(m.state)["stack"])
+    dtype = cdt if cdt is not None else jnp.dtype(m.conf.conf.dtype)
+    buf = jnp.zeros((S,) + tuple(micro_shape), dtype)
+    keys = jnp.zeros((S, v, 2), jnp.uint32)
+    vstage = jax.vmap(_stage_body(plan, cdt))
+    return saved_bytes(vstage, params_stack, state_stack, buf, keys,
+                       policy=policy)
+
+
 def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
     """Build the pipelined M-microbatch loss:
 
@@ -378,12 +431,26 @@ def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
                                exchange before the ring scan) — a
                                collective-permute leaking onto `data`
     """
+    from ..nn.conf.base import cast_floating
+    from ..nn.remat import resolve_policy
+
     m = plan.model
     layers = m.layers
     n = len(layers)
     lo, hi, S, v = plan.lo, plan.hi, plan.n_stages, plan.slots
     preproc = m.conf.preprocessors
     mesh = plan.mesh
+    # bf16-compute / fp32-master (ISSUE 18): same semantics as
+    # MultiLayerNetwork._forward — floating inputs cast once, hidden
+    # layers compute on cast params (the cast's cotangent returns in the
+    # master dtype), the output layer keeps master params so softmax/
+    # loss stay f32. The old compute_dtype rejection is lifted.
+    cdt = m._compute_dtype
+    # selective remat (ISSUE 18): the stage layers' (inherited) policy
+    # decides what each ring tick's checkpoint boundary saves — the
+    # stage run is homogeneous, so layers[lo] speaks for every slot
+    stage_policy = resolve_policy(getattr(layers[lo], "remat_policy",
+                                          None))
     pipe, data = plan.pipe_axis, plan.data_axis
     drop_constraints = mutate == "drop_stage_constraint"
     permute_data = mutate == "permute_data_axis"
@@ -408,40 +475,41 @@ def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
         for i in range(lo):
             if i in preproc:
                 x = preproc[i].apply(x)
+            p_i = (params_head[i] if cdt is None
+                   else cast_floating(params_head[i], cdt))
             x, new_state[i] = layers[i].apply(
-                params_head[i], state_head[i], x, train=True, rng=lk[i],
+                p_i, state_head[i], x, train=True, rng=lk[i],
                 mask=None)
         if lo in preproc:
             x = preproc[lo].apply(x)
         return x, tuple(new_state)
 
-    def stage_apply(slot_params, slot_states, x, keys):
-        # ONE stage's v layers; vmapped over the stage axis by the caller
-        # (confs are identical across stages by PipelinePlan construction)
-        new_states = []
-        for r in range(v):
-            x, s_r = layers[lo + r].apply(
-                slot_params[r], slot_states[r], x, train=True,
-                rng=keys[r], mask=None)
-            new_states.append(s_r)
-        return x, tuple(new_states)
+    # ONE stage's v layers; vmapped over the stage axis below (confs are
+    # identical across stages by PipelinePlan construction)
+    stage_apply = _stage_body(plan, cdt)
 
     def tail_loss(params_tail, state_tail, h, y, lk, out_rng, lm):
         new_state = list(state_tail)
         for k, i in enumerate(range(hi, n - 1)):
             if i in preproc:
                 h = preproc[i].apply(h)
+            p_k = (params_tail[k] if cdt is None
+                   else cast_floating(params_tail[k], cdt))
             h, new_state[k] = layers[i].apply(
-                params_tail[k], state_tail[k], h, train=True, rng=lk[i],
+                p_k, state_tail[k], h, train=True, rng=lk[i],
                 mask=None)
         if (n - 1) in preproc:
             h = preproc[n - 1].apply(h)
+        # output layer on MASTER params: its matmul promotes cdt
+        # activations back up, softmax/loss stay f32
         loss = layers[-1].loss_score(params_tail[-1], state_tail[-1], h, y,
                                      train=True, rng=out_rng, mask=lm)
         return loss, tuple(new_state)
 
     def loss_fn(params_pp, state_pp, keys, xs, ys, lms):
         f32 = jnp.float32
+        if cdt is not None and jnp.issubdtype(xs.dtype, jnp.floating):
+            xs = xs.astype(cdt)
         M = xs.shape[0]
         T = M + S - 1
         lk_all, out_all = jax.vmap(micro_keys)(keys)   # [M, n-1, 2], [M, 2]
@@ -464,11 +532,15 @@ def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
             return (slot_iota == i).astype(f32)
 
         def read_slot(buf_m, i):
-            oh = onehot(i).reshape((M,) + (1,) * (buf_m.ndim - 1))
+            # selector cast to the buffer dtype (1.0/0.0 are exact in
+            # bf16 too) so mixed-precision buffers don't promote to f32
+            oh = onehot(i).astype(buf_m.dtype).reshape(
+                (M,) + (1,) * (buf_m.ndim - 1))
             return jnp.sum(buf_m * oh, axis=0)
 
         def write_slot(buf_m, val, i):
-            oh = onehot(i).reshape((M,) + (1,) * (buf_m.ndim - 1))
+            oh = onehot(i).astype(buf_m.dtype).reshape(
+                (M,) + (1,) * (buf_m.ndim - 1))
             return buf_m + oh * val[None]
 
         # -- 1) head: microbatches in order (state threads), the M
@@ -500,7 +572,8 @@ def _pp_loss_fn(plan: PipelinePlan, mutate: Optional[str] = None):
         #       the activation ENTERING stage s this tick; the stacked
         #       stage axis is pipe-sharded, so the end-of-tick shift
         #       lowers to a collective-permute on `pipe` only.
-        vstage = jax.checkpoint(jax.vmap(stage_apply))
+        vstage = jax.checkpoint(jax.vmap(stage_apply),
+                                policy=stage_policy)
         buf0 = jnp.zeros((S,) + inj.shape[1:], inj.dtype)
         out0 = jnp.zeros_like(inj)
         stage_ids = jnp.arange(S, dtype=jnp.int32)
@@ -587,10 +660,18 @@ def _pp_opt_step(plan: PipelinePlan, zero_plan=None,
 
 
 def _pp_info(plan: PipelinePlan, zero_plan=None):
+    m = plan.model
     info = {"pp_constraints": PP_CONSTRAINT_SITES,
             "n_stages": plan.n_stages, "slots": plan.slots,
             "stage_run": (plan.lo, plan.hi),
-            "expected_constraints": PP_CONSTRAINT_SITES}
+            "expected_constraints": PP_CONSTRAINT_SITES,
+            # remat/precision accounting (ISSUE 18, the _ZeroPlan.info
+            # pattern): the stage checkpoint's effective policy + the
+            # compute dtype; per-shape activation bytes via
+            # `pp_stage_saved_bytes(plan, micro_shape, policy=...)`
+            "remat": {"policy": getattr(m.layers[plan.lo], "remat_policy",
+                                        None),
+                      "compute_dtype": m.conf.conf.compute_dtype}}
     if zero_plan is not None:
         info["zero"] = dict(zero_plan.info)
         info["expected_constraints"] += zero_plan.expected_constraints()

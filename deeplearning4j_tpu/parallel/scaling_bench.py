@@ -279,20 +279,26 @@ def measure_paired_accum(n_devices: int, micro_batch: int = 32, m: int = 8,
 
 
 def _build_transformer_lm(vocab: int, width: int, heads: int, depth: int,
-                          seq: int):
+                          seq: int, compute_dtype=None, remat_policy=None):
     """GPT-style LM for the mesh2d tokens/s config (ISSUE 14 / ROADMAP
     item 5): vocab-shardable embedding -> `depth` transformer blocks
     (Megatron-role params, kernels/attention.py core) -> time-distributed
     softmax head. Widths are chosen divisible by every mesh axis the
-    8-device reshapes use (vocab/width/ffn % 8 == 0, heads % 4 == 0)."""
+    8-device reshapes use (vocab/width/ffn % 8 == 0, heads % 4 == 0).
+    `compute_dtype`/`remat_policy` feed the flash-mode precision/remat
+    arms (ISSUE 18)."""
     from ..nn.conf import InputType, NeuralNetConfiguration
     from ..nn.layers import (EmbeddingSequenceLayer, RnnOutputLayer,
                              TransformerBlock)
     from ..nn.multilayer import MultiLayerNetwork
     from ..nn.updaters import Adam
 
-    b = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3)).list()
-         .layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width)))
+    b = NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+    if compute_dtype is not None:
+        b = b.compute_dtype(compute_dtype)
+    if remat_policy is not None:
+        b = b.remat_policy(remat_policy)
+    b = b.list().layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width))
     for _ in range(depth):
         b = b.layer(TransformerBlock(n_heads=heads))
     conf = (b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
@@ -459,6 +465,124 @@ def measure_mesh2d(n_devices: int = 8, vocab: int = 256, width: int = 128,
         # mesh — see docstring)
         "target": 0.15,
         "ok": zmom <= 0.15}
+    return out
+
+
+def measure_flash(n_devices: int = 8, vocab: int = 64, width: int = 32,
+                  heads: int = 4, depth: int = 2, seq: int = 16,
+                  global_batch: int = 8, steps: int = 2, reps: int = 3):
+    """Flash-under-SPMD ablation (ISSUE 18): the transformer LM trained
+    ZERO1×TP on the (2,4) mesh with the attention body swapped per arm,
+    in ALTERNATING measured windows (rep i times every arm back-to-back
+    so host-load drift contaminates them equally):
+
+      * `flash_spmd`  — the shard_map'd Pallas kernel, FORCED on
+        (`flash="spmd"`); on the CPU mesh the kernel runs in Pallas
+        INTERPRET mode, so its wall-clock is emulation overhead, not a
+        hardware prediction;
+      * `einsum_fp32` — the einsum fallback, fp32 throughout (the
+        capability probe's choice on this backend);
+      * `einsum_bf16` — the einsum fallback under bf16-compute /
+        fp32-master (`compute_dtype="bfloat16"`).
+
+    Reports tokens/s per arm with paired per-round ratios + spreads for
+    flash-vs-einsum and bf16-vs-fp32, and the REMAT-POLICY activation-
+    bytes column: `pp_stage_saved_bytes` of the same LM's 1F1B stage on
+    the (2,2,2) mesh under every registered policy — the static
+    accounting the selective-remat tentpole publishes.
+
+    Virtual-mesh caveat: interpret-mode Pallas is ORDERS slower than the
+    compiled einsum on CPU, so there is NO wall-clock gate on the flash
+    ratio (the TPU claim is carried by the IR lint: pallas_call present,
+    zero reshard-byte regression). The gate rides on the activation-byte
+    column instead — `dots` must save strictly less than `everything`
+    (the un-checkpointed stage residual set), which is exact arithmetic
+    on aval shapes and load-independent."""
+    import time as _time
+
+    from .pipeline import pp_stage_saved_bytes
+    from .trainer import ParallelTrainer, ShardingStrategy
+
+    if n_devices != 8:
+        raise SystemExit(
+            f"flash mode benches the (2,4) reshape of an 8-device mesh; "
+            f"got --devices {n_devices}")
+    arms = [
+        ("flash_spmd", "spmd", None),
+        ("einsum_fp32", False, None),
+        ("einsum_bf16", False, "bfloat16"),
+    ]
+    ds = _lm_data(vocab, seq, global_batch)
+    trainers = {}
+    for name, flash, cdt in arms:
+        model = _build_transformer_lm(vocab, width, heads, depth, seq,
+                                      compute_dtype=cdt)
+        trainers[name] = ParallelTrainer(
+            model, mesh_shape=(2, 4), strategy=ShardingStrategy.ZERO1_TP,
+            collect_stats=False, flash=flash)
+    for tr in trainers.values():
+        tr.fit(ds)
+        float(tr.score())
+
+    tokens = global_batch * seq * steps
+    rep_tps = {name: [] for name in trainers}
+    for _ in range(max(2, int(reps))):
+        for name, tr in trainers.items():
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                tr.fit(ds)
+            float(tr.score())
+            rep_tps[name].append(tokens / (_time.perf_counter() - t0))
+
+    out = {"mode": "flash", "devices": n_devices,
+           "model": {"vocab": vocab, "width": width, "heads": heads,
+                     "depth": depth, "seq": seq,
+                     "global_batch": global_batch},
+           "arms": {}}
+    for name, tr in trainers.items():
+        tps = sorted(rep_tps[name])
+        out["arms"][name] = {
+            "flash_mode": tr.flash_mode,
+            "tokens_per_s": round(_median(tps), 1),
+            "tokens_per_s_rep": [round(v, 1) for v in tps]}
+
+    def _paired(a, b):
+        rs = sorted(x / y for x, y in zip(rep_tps[a], rep_tps[b]))
+        return (round(rs[len(rs) // 2], 3),
+                [round(rs[0], 3), round(rs[-1], 3)])
+
+    out["flash_vs_einsum_paired"], out["flash_vs_einsum_spread"] = \
+        _paired("flash_spmd", "einsum_fp32")
+    out["bf16_vs_fp32_paired"], out["bf16_vs_fp32_spread"] = \
+        _paired("einsum_bf16", "einsum_fp32")
+    out["wall_clock_caveat"] = (
+        "flash arm runs the Pallas kernel in interpret mode on the "
+        "virtual CPU mesh; its tokens/s is emulation overhead, not a "
+        "TPU prediction — the kernel claim is IR-lint-carried")
+
+    # remat-policy activation-bytes column: static 1F1B stage accounting
+    # of the SAME LM on the (data=2, model=2, pipe=2) mesh
+    pp_tr = ParallelTrainer(
+        _build_transformer_lm(vocab, width, heads, depth, seq),
+        mesh_shape=(2, 2, 2), strategy=ShardingStrategy.ZERO1_TP_PP,
+        collect_stats=False)
+    micro = (max(1, global_batch // 4), seq, width)
+    col = {str(p): pp_stage_saved_bytes(pp_tr._pp_plan, micro, policy=p)
+           for p in (None, "nothing", "dots", "dots_no_batch",
+                     "everything")}
+    out["remat_policy_saved_bytes"] = col
+    out["remat_micro_shape"] = list(micro)
+
+    reduction = (col["everything"] - col["dots"]) / col["everything"] \
+        if col["everything"] else 0.0
+    out["gate"] = {
+        "metric": "flash-remat-dots-vs-everything-saved-bytes",
+        "value": round(reduction, 4),
+        # `dots` must cut the stage's saved-residual bytes vs the
+        # blanket un-checkpointed residual set; exact static arithmetic,
+        # so any nonzero target is load-independent
+        "target": 0.25,
+        "ok": reduction >= 0.25}
     return out
 
 
@@ -775,7 +899,8 @@ def main(argv=None):
                     help="skip the paired replicated-vs-ZeRO ablation")
     ap.add_argument("--zero-stage", type=int, choices=(1, 2),
                 default=None)  # dp mode: 1; accum mode: 2
-    ap.add_argument("--mode", choices=("dp", "pipeline", "accum", "mesh2d"),
+    ap.add_argument("--mode",
+                    choices=("dp", "pipeline", "accum", "mesh2d", "flash"),
                     default="dp")
     ap.add_argument("--micro-batch", type=int, default=32)
     ap.add_argument("--accum-m", type=int, default=8)
@@ -792,7 +917,7 @@ def main(argv=None):
                     help="mlp hidden width override (accum mode; default "
                          "1024 — compute-dense enough to be representative)")
     a = ap.parse_args(argv)
-    if a.global_batch is None and a.mode != "mesh2d":
+    if a.global_batch is None and a.mode not in ("mesh2d", "flash"):
         a.global_batch = 64   # the declared dp/pipeline config
     _provision(a.devices)
     from ..telemetry import runtime as telemetry_runtime
@@ -807,6 +932,14 @@ def main(argv=None):
             steps=a.steps, reps=max(2, a.reps), model=a.model or "mlp",
             image=a.image,
             strategy="replicated" if a.no_zero else f"zero{stage}", **kw)
+        sess.watermarks.sample()
+        out["telemetry"] = _telemetry_fields(sess)
+        print(json.dumps(out))
+        return
+    if a.mode == "flash":
+        out = measure_flash(
+            a.devices, seq=min(a.seq, 16), steps=a.steps,
+            global_batch=a.global_batch or 8, reps=max(2, a.reps))
         sess.watermarks.sample()
         out["telemetry"] = _telemetry_fields(sess)
         print(json.dumps(out))

@@ -32,6 +32,7 @@ killing the replicated-updater tax BENCH_r05 measured at ~2.3 s/step.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -45,7 +46,10 @@ from ..datasets.iterators import DataSet, DataSetIterator, MultiDataSet
 from ..telemetry.compile_watch import watch_compiles
 from ..telemetry.runtime import active as _tel_active, null_span as _null_span
 
-__all__ = ["ParallelTrainer", "ParallelWrapper", "TrainingMode"]
+__all__ = ["ParallelTrainer", "ParallelWrapper", "TrainingMode",
+           "configure_flash_attention"]
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingMode:
@@ -180,6 +184,86 @@ def _validate_mode_strategy(mode: str, strategy: str, mesh=None,
                 f"{', '.join(_MESH2D_STRATEGIES)}")
 
 
+#: strategies whose sharded step can host the Pallas flash kernel via
+#: shard_map (ISSUE 18): the Megatron roles model-shard the head axis,
+#: so each shard's local [B/d, T, H/m, Dh] block is a standalone
+#: attention problem — zero collectives inside the kernel region. The
+#: 1F1B strategies stay on einsum: the stage body nests shard_map under
+#: vmap under scan under jax.checkpoint, and the (data, model, pipe)
+#: specs don't cover the pipe axis.
+_FLASH_SPMD_STRATEGIES = (ShardingStrategy.TENSOR_PARALLEL,
+                          ShardingStrategy.ZERO1_TP)
+
+
+def configure_flash_attention(model, mesh, strategy,
+                              model_axis: str = MeshAxes.MODEL,
+                              data_axis: str = MeshAxes.DATA,
+                              force=None):
+    """Capability-gated attention-implementation selection for every
+    trainer-managed layer with a `flash` switch (TransformerBlock).
+
+    GSPMD cannot partition a Pallas custom call, so the plain flash
+    kernel inside a sharded jit would force replication — the silent
+    reshard the IR lint exists to catch. Instead of the old blanket
+    `flash=False` pin, pick per capability:
+
+      * "spmd" — `kernels.attention.flash_attention_spmd`: the kernel
+        under `shard_map` over (data, model). Requires a strategy whose
+        activations are laid out [B@data, T, H@model, Dh] locally
+        (`_FLASH_SPMD_STRATEGIES`) and a live Pallas backend
+        (`kernels.pallas_supported()` — TPU, not disabled).
+      * False — einsum `attention_reference` fallback (GSPMD shards
+        plain einsums cleanly). CPU/virtual meshes land here: the
+        interpret-mode kernel is a correctness tool, not a fast path.
+
+    `force` overrides the probe ("spmd"/False) — tests and IR probes
+    use force="spmd" to exercise the shard_map lowering on the virtual
+    mesh, where interpret mode makes it correct but slow.
+
+    Mutates instance attrs only (`conf_l.flash`, `conf_l.flash_spmd`);
+    class-level "auto" stays for standalone/single-device use. Returns
+    `(mode, reason)` and logs one line; (None, reason) when the model
+    has no flash-switched layers.
+    """
+    from ..nn.graph import ComputationGraph
+
+    layer_confs = [conf_l for conf_l in
+                   (model.conf.vertices.values()
+                    if isinstance(model, ComputationGraph)
+                    else getattr(model, "layers", ()) or ())
+                   if hasattr(conf_l, "flash")]
+    if not layer_confs:
+        return None, "no attention layers"
+    if force is not None:
+        mode, reason = force, f"forced ({force!r})"
+    elif strategy not in _FLASH_SPMD_STRATEGIES:
+        mode, reason = False, (
+            f"strategy '{strategy}' has no shard_map flash path "
+            f"(supported: {', '.join(_FLASH_SPMD_STRATEGIES)}) — einsum "
+            "attention_reference (GSPMD-partitionable) selected")
+    else:
+        from ..kernels import pallas_supported
+
+        if pallas_supported():
+            mode, reason = "spmd", (
+                "Pallas flash attention under shard_map over "
+                f"('{data_axis}', '{model_axis}') — per-shard kernel, "
+                "zero collectives in the kernel region")
+        else:
+            mode, reason = False, (
+                f"backend '{jax.default_backend()}' has no compiled "
+                "Pallas path (CPU/virtual mesh, or "
+                "DL4J_TPU_DISABLE_PALLAS) — einsum attention_reference "
+                "selected; rerun on a TPU backend for the kernel")
+    for conf_l in layer_confs:
+        conf_l.flash = mode
+        conf_l.flash_spmd = ((mesh, data_axis, model_axis)
+                             if mode == "spmd" else None)
+    log.info("flash attention [%d layer(s), strategy=%s]: %s",
+             len(layer_confs), strategy, reason)
+    return mode, reason
+
+
 class ParallelTrainer:
     """fit(iterator) over a device mesh.
 
@@ -212,7 +296,8 @@ class ParallelTrainer:
                  collect_stats: bool = False,
                  zero_bucket_mb: Optional[float] = None,
                  zero_reduce_dtype: Optional[str] = None,
-                 mesh_shape: Optional[tuple] = None):
+                 mesh_shape: Optional[tuple] = None,
+                 flash=None):
         if mesh_shape is not None:
             # mesh shorthand: (d, m) builds the 2-D (data, model) mesh
             # (ISSUE 14); (d, m, p) the 3-D (data, model, pipe) mesh for
@@ -255,20 +340,12 @@ class ParallelTrainer:
                 "them — drop the knobs or switch strategy")
         if model.params is None:
             model.init()
-        # layers with a kernel-vs-einsum attention switch (TransformerBlock
-        # `flash`) must take the einsum path under ANY trainer-managed
-        # sharding: GSPMD cannot partition a Pallas custom call, so the
-        # flash kernel inside a sharded jit would force replication (or
-        # fail to partition) — exactly the silent reshard the IR lint
-        # exists to catch. Instance attr only; standalone/single-device
-        # use keeps the class-level "auto".
-        from ..nn.graph import ComputationGraph
-        layer_confs = (model.conf.vertices.values()
-                       if isinstance(model, ComputationGraph)
-                       else getattr(model, "layers", ()) or ())
-        for conf_l in layer_confs:
-            if hasattr(conf_l, "flash"):
-                conf_l.flash = False
+        # attention implementation per capability (ISSUE 18): shard_map'd
+        # Pallas kernel where the strategy/backend supports it, einsum
+        # fallback (with one log line) elsewhere — replaces the old
+        # blanket flash=False pin
+        self.flash_mode, _ = configure_flash_attention(
+            model, mesh, strategy, model_axis, data_axis, force=flash)
         self.model = model
         self.mesh = mesh
         self.mode = mode
@@ -658,7 +735,8 @@ class ParallelTrainer:
         from ..fault.resume import sharded_fit_checkpointer
         ckpt = sharded_fit_checkpointer(
             self, checkpoint_dir, checkpoint_every, resume,
-            context={"grad_accumulation": accum_m})
+            context={"grad_accumulation": accum_m,
+                     **self.model._precision_remat_context()})
         skip, done_epochs = (0, 0) if ckpt is None else ckpt.resume_into(data)
         from ..datasets.pipeline import build_pipeline
         data, close = build_pipeline(data, pad_ragged=pad_ragged,

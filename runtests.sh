@@ -59,6 +59,17 @@
 #                            the TP-only / DP×TP / ZERO1×TP paired arms
 #                            (per-device bytes + per-axis collective
 #                            payloads JSON)
+#   ./runtests.sh flash      flash-under-SPMD + precision/remat smoke:
+#                            the shard_map'd Pallas attention suite
+#                            (spmd-vs-einsum equivalence under zero1_tp,
+#                            capability gating + log line, IR custom-
+#                            call probe + drop_flash mutation) and the
+#                            mixed-precision/selective-remat suite
+#                            (policy numerics no-ops, bf16 across fit
+#                            paths, 1F1B compute_dtype + resume) plus
+#                            one paired flash-vs-einsum/bf16-vs-fp32
+#                            bench rep with the remat activation-bytes
+#                            column
 #   ./runtests.sh obs        observability smoke: the ISSUE 17 suite
 #                            (connected /generate trace, Tracer
 #                            saturation accounting, flight-recorder ring
@@ -136,6 +147,15 @@ if [[ "${1:-}" == "mesh2d" ]]; then
         XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
         --mode mesh2d --steps 2 --reps 2
+fi
+if [[ "${1:-}" == "flash" ]]; then
+    echo "=== flash-under-SPMD + precision/remat smoke ==="
+    python -m pytest tests/test_flash_spmd.py tests/test_precision_remat.py -q
+    echo "=== paired flash-vs-einsum bench rep (zero1_tp, remat column) ==="
+    exec env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
+        --mode flash --steps 1 --reps 2
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="
