@@ -31,7 +31,8 @@ __all__ = ["build_entries", "tiny_mlp", "nn_entries", "graph_entries",
            "parallel_entries", "zero_accum_entry", "mesh2d_entries",
            "mesh2d_zero1_tp_entry", "flash_spmd_entry", "flash_entries",
            "pp_entry", "pp_entries", "serving_entries", "decode_entry",
-           "decode_entries", "virtual_mesh"]
+           "decode_entries", "elastic_restore_entry", "elastic_entries",
+           "virtual_mesh"]
 
 
 def virtual_mesh():
@@ -725,6 +726,92 @@ def decode_entries() -> List[IrEntry]:
     return [decode_entry("prefill"), decode_entry("tick")]
 
 
+def elastic_restore_entry(shape: Tuple[int, int] = (2, 4),
+                          hidden: int = 64,
+                          mutate: Optional[str] = None) -> IrEntry:
+    """The elastic-restore re-placement step (ISSUE 19): after a mesh
+    reshape, `load_elastic_state` -> `_prepare` re-lands the restored
+    host trees through identity jits with sharded out_shardings (the
+    `parallel/{param,opt}_placement` entries). Landing replicated host
+    bytes onto shards is pure slicing — the compiled program must move
+    ZERO collective bytes on EVERY axis (floor-budgeted at the linter's
+    1KiB slack floor). A hidden width of 64 makes each dense kernel
+    (8x64, 2KiB f32) bigger than that floor, so a single wrong-direction
+    gather is an unambiguous finding. Public so tests can seed the
+    mutation through the same builder:
+
+      mutate="gather_replicated"  the inputs arrive SHARDED and the
+                                  out_shardings are replicated — the
+                                  restore path compiles to all-gathers
+                                  (a resize that re-materializes every
+                                  shard on every device) and the
+                                  per-axis byte budgets blow
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import (Adam, DenseLayer, InputType, MultiLayerNetwork,
+                    NeuralNetConfiguration, OutputLayer)
+    from ..parallel.mesh import MeshAxes, make_mesh
+    from ..parallel.sharding import (ShardingStrategy, model_layer_hints,
+                                     param_specs)
+    from ..parallel.zero import zero_opt_shardings
+    from ..telemetry.compile_watch import watch_compiles
+
+    if mutate not in (None, "gather_replicated"):
+        raise ValueError(f"unknown mutation {mutate!r}")
+    d, m = shape
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    model = MultiLayerNetwork(conf).init()
+    mesh = make_mesh({MeshAxes.DATA: d, MeshAxes.MODEL: m})
+    base = param_specs(model.params, ShardingStrategy.ZERO1_TP, mesh,
+                      layers=model_layer_hints(model))
+    p_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), base,
+        is_leaf=lambda s: isinstance(s, P))
+    o_sh = zero_opt_shardings(model.updater_state, model.params, mesh,
+                              base=base)
+    repl_p = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P()), p_sh,
+        is_leaf=lambda s: isinstance(s, NamedSharding))
+    repl_o = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P()), o_sh,
+        is_leaf=lambda s: isinstance(s, NamedSharding))
+    if mutate == "gather_replicated":
+        params = jax.device_put(model.params, p_sh)
+        opt = jax.device_put(model.updater_state, o_sh)
+        out_sh = (repl_p, repl_o)
+    else:
+        # restore lands host (replicated) trees onto the shards
+        params = jax.device_put(model.params, repl_p)
+        opt = jax.device_put(model.updater_state, repl_o)
+        out_sh = (p_sh, o_sh)
+    jitted = watch_compiles(
+        jax.jit(lambda p, o: (p, o), out_shardings=out_sh),
+        f"analysis/ir_probe:elastic_restore_{d}x{m}").__wrapped__
+    entry = IrEntry(
+        f"parallel/elastic_restore_{d}x{m}", "parallel/elastic.py",
+        fn=jitted, args=(params, opt),
+        mesh_axes=tuple(mesh.axis_names))
+    entry.axis_sizes = {"data": d, "model": m}
+    entry.declared_bytes_by_axis = {"data": 0, "model": 0, "other": 0}
+    return entry
+
+
+def elastic_entries() -> List[IrEntry]:
+    """The elastic-training plane's compiled surface (ISSUE 19): the
+    restore re-placement identity step on the (2, 4) mesh, hard-floored
+    at zero collective bytes on every axis — a restore that compiles to
+    gathers would silently turn every resize into a full-state
+    re-broadcast."""
+    return [elastic_restore_entry((2, 4))]
+
+
 def build_entries() -> List[IrEntry]:
     """The full IR roster, in deterministic order. Every entry family the
     package registers through watch_compiles/record_aot is represented;
@@ -740,4 +827,5 @@ def build_entries() -> List[IrEntry]:
     entries += flash_entries()
     entries += serving_entries()
     entries += decode_entries()
+    entries += elastic_entries()
     return entries

@@ -17,17 +17,25 @@ checkpoints as THE fault-tolerance primitive (Eisenman et al.,
   * `guard`     — `TrainingGuard`: isfinite check on every step's loss
                   with warn/skip_batch/rollback/halt policies, plus
                   bounded-backoff retry for transient iterator errors.
-  * `injection` — `FaultyIterator` + `crash_at_write` crash points, so
-                  every recovery path above is tested deterministically.
+  * `injection` — `FaultyIterator` + `crash_at_write` crash points, plus
+                  the ISSUE-19 process-level injectors (`kill_at_step`,
+                  `hang_at_step`, `sigterm_at_step`,
+                  `install_faults_from_env`) for the elastic kill/rejoin
+                  drills — so every recovery path above is tested
+                  deterministically.
 
 Everything emits telemetry through the PR-2 registry
 (`dl4j_fault_nonfinite_steps_total`, `dl4j_fault_retries_total`,
-`dl4j_fault_rollbacks_total`, `dl4j_checkpoint_{save,restore}_seconds`).
+`dl4j_fault_rollbacks_total`, `dl4j_checkpoint_{save,restore}_seconds`,
+`dl4j_elastic_*_total`, `dl4j_elastic_snapshot_seconds`).
 """
 from .atomic import (COMMIT_MARKER, CorruptCheckpointError, atomic_replace,
                      read_commit_marker, sha256_hex, write_commit_marker)
 from .guard import GuardPolicy, NonFiniteScoreError, TrainingGuard
-from .injection import FaultyIterator, SimulatedCrash, crash_at_write
+from .injection import (FaultyIterator, SimulatedCrash, clear_crash_hooks,
+                        crash_at_write, hang_at_step,
+                        install_faults_from_env, kill_at_step,
+                        sigterm_at_step)
 from .resume import (CheckpointManager, FitCheckpointer,
                      maybe_fit_checkpointer, sharded_fit_checkpointer)
 
@@ -36,6 +44,8 @@ __all__ = [
     "read_commit_marker", "sha256_hex", "write_commit_marker",
     "GuardPolicy", "NonFiniteScoreError", "TrainingGuard",
     "FaultyIterator", "SimulatedCrash", "crash_at_write",
+    "kill_at_step", "hang_at_step", "sigterm_at_step",
+    "install_faults_from_env", "clear_crash_hooks",
     "CheckpointManager", "FitCheckpointer", "maybe_fit_checkpointer",
     "sharded_fit_checkpointer",
 ]

@@ -19,10 +19,27 @@ Two injection surfaces:
 `SimulatedCrash` subclasses BaseException so it sails through the
 `except Exception` retry/cleanup layers the way SIGKILL would — only test
 harnesses catch it.
+
+**Process-level injectors** (ISSUE 19, the elastic drills): where
+`crash_at_write` models a death *inside this interpreter* (an exception a
+harness can observe), `kill_at_step`/`hang_at_step` model the death of a
+whole WORKER in a multi-process world — `os._exit` (no teardown, the
+userspace stand-in for SIGKILL/preemption) or an indefinite stall (the
+lease-expiry path). They ride the ``elastic/step`` crash point the
+`ElasticTrainer` supervision loop fires once per optimizer step, and
+`install_faults_from_env` arms them (plus the write-boundary injectors)
+from ``DL4J_*`` environment variables so `tests/_dist_child.py` children
+can be killed at exact steps / exact two-phase-commit boundaries:
+``elastic/shards_written`` (shard durable but unmarked),
+``elastic/durable_marked`` (between the phases) and
+``elastic/commit_marker`` (torn COMMIT marker — temp bytes written, never
+renamed).
 """
 from __future__ import annotations
 
 import contextlib
+import os
+import signal
 import time
 from typing import Callable, Dict, Optional
 
@@ -31,7 +48,14 @@ import numpy as np
 from ..datasets.iterators import DataSet, DataSetIterator
 
 __all__ = ["SimulatedCrash", "fire_crash_point", "crash_at_write",
+           "install_crash_at_write", "kill_at_step", "hang_at_step",
+           "sigterm_at_step", "install_faults_from_env", "clear_crash_hooks",
            "FaultyIterator"]
+
+#: the per-optimizer-step crash point the ElasticTrainer loop fires
+#: (info carries step= and worker=) — the hook surface for kill/hang/
+#: SIGTERM-at-step process-level injection
+STEP_POINT = "elastic/step"
 
 
 class SimulatedCrash(BaseException):
@@ -72,6 +96,121 @@ def crash_at_write(point: str = "zip/temp_written", nth: int = 1):
             _crash_hooks.pop(point, None)
         else:
             _crash_hooks[point] = prev
+
+
+def install_crash_at_write(point: str, nth: int = 1):
+    """Non-contextmanager `crash_at_write`: installs a persistent hook
+    raising SimulatedCrash on the nth firing of `point`. For subprocess
+    children (armed from env, die with the process) — in-process tests
+    should prefer the scoped `crash_at_write`. Returns the firing-count
+    state dict."""
+    state = {"fired": 0}
+
+    def cb(p, info):
+        state["fired"] += 1
+        if state["fired"] == nth:
+            raise SimulatedCrash(
+                f"injected crash at {p} (firing #{nth}; {info})")
+
+    _crash_hooks[point] = cb
+    return state
+
+
+def _install_step_hook(n: int, action: Callable[[dict], None]):
+    n = int(n)
+
+    def cb(p, info):
+        if int(info.get("step", -1)) == n:
+            action(info)
+
+    _crash_hooks[STEP_POINT] = cb
+
+
+def kill_at_step(n: int, exit_code: int = 137):
+    """Hard-kill this process when the elastic supervision loop reaches
+    optimizer step `n`: `os._exit` skips every finally/atexit/flush — the
+    closest userspace stand-in for SIGKILL/TPU preemption. Default exit
+    code 137 (= 128+SIGKILL) so harnesses can tell an injected kill from
+    an ordinary crash."""
+    _install_step_hook(n, lambda info: os._exit(exit_code))
+
+
+def hang_at_step(n: int, hang_s: float = 3600.0):
+    """Stall this process at optimizer step `n` without exiting — the
+    worker stops renewing its heartbeat lease while its peer keeps
+    running, which is exactly the failure the lease TTL exists to detect
+    (a wedged host looks identical to a dead one from the outside)."""
+    _install_step_hook(n, lambda info: time.sleep(float(hang_s)))
+
+
+def sigterm_at_step(n: int):
+    """Deliver SIGTERM to OURSELVES at optimizer step `n` — deterministic
+    preemption notice for drills: the elastic loop's handler defers it to
+    the next superstep edge and requests a cross-process drain there."""
+    _install_step_hook(
+        n, lambda info: os.kill(os.getpid(), signal.SIGTERM))
+
+
+def _exit_at_write(point: str, nth: int = 1, exit_code: int = 137):
+    """Hard `os._exit` on the nth firing of a write-boundary crash point
+    — the two-phase-commit kill drills use this to die exactly between
+    a durable write and its marker with NO Python teardown."""
+    state = {"fired": 0}
+
+    def cb(p, info):
+        state["fired"] += 1
+        if state["fired"] == nth:
+            os._exit(exit_code)
+
+    _crash_hooks[point] = cb
+
+
+def clear_crash_hooks():
+    """Drop every installed crash hook (test teardown for the persistent
+    `install_*` variants; the scoped `crash_at_write` cleans up itself)."""
+    _crash_hooks.clear()
+
+
+def install_faults_from_env(env=None):
+    """Arm process-level injectors from environment variables — the
+    subprocess injection surface for `tests/_dist_child.py` children
+    (the parent can't reach into a child's interpreter, but it can set
+    its env):
+
+      DL4J_KILL_AT_STEP=n            kill_at_step(n)
+      DL4J_HANG_AT_STEP=n[:secs]     hang_at_step(n, secs)
+      DL4J_SIGTERM_AT_STEP=n         sigterm_at_step(n)
+      DL4J_CRASH_AT_WRITE=point[:nth]  raise SimulatedCrash at the point
+      DL4J_EXIT_AT_WRITE=point[:nth]   os._exit(137) at the point (the
+                                       mid-commit kill drills)
+
+    Returns the list of armed injector names (empty when none set)."""
+    env = os.environ if env is None else env
+    armed = []
+    v = env.get("DL4J_KILL_AT_STEP")
+    if v:
+        kill_at_step(int(v))
+        armed.append(f"kill_at_step({v})")
+    v = env.get("DL4J_HANG_AT_STEP")
+    if v:
+        n, _, secs = v.partition(":")
+        hang_at_step(int(n), float(secs) if secs else 3600.0)
+        armed.append(f"hang_at_step({n})")
+    v = env.get("DL4J_SIGTERM_AT_STEP")
+    if v:
+        sigterm_at_step(int(v))
+        armed.append(f"sigterm_at_step({v})")
+    v = env.get("DL4J_CRASH_AT_WRITE")
+    if v:
+        point, _, nth = v.partition(":")
+        install_crash_at_write(point, int(nth) if nth else 1)
+        armed.append(f"crash_at_write({point})")
+    v = env.get("DL4J_EXIT_AT_WRITE")
+    if v:
+        point, _, nth = v.partition(":")
+        _exit_at_write(point, int(nth) if nth else 1)
+        armed.append(f"exit_at_write({point})")
+    return armed
 
 
 class FaultyIterator(DataSetIterator):

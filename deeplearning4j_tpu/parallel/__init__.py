@@ -1,4 +1,5 @@
-from .mesh import MeshAxes, make_hybrid_mesh, make_mesh
+from .mesh import (MeshAxes, make_hybrid_mesh, make_mesh,
+                   surviving_mesh_shape)
 from .sharding import ShardingStrategy, param_specs, shard_model
 from .trainer import ParallelTrainer, ParallelWrapper, TrainingMode
 from .zero import (ZeroConfig, assign_buckets, collective_overlap_fraction,
@@ -12,10 +13,13 @@ from .pipeline import (PipelinedDenseStack,
                        PipelinedNetworkTrainer, pipeline_forward)
 from .distributed import (global_mesh, initialize, is_multi_host,
                           local_batch_slice, process_index)
-from .checkpoint import ShardedCheckpoint, restore_sharded, save_sharded
+from .checkpoint import (CoordinatedShardStore, ElasticWorkerLost,
+                         ShardedCheckpoint, restore_sharded, save_sharded)
+from .elastic import (CoordinatedCheckpoint, DrainSignal, ElasticTrainer,
+                      HeartbeatLease)
 
 __all__ = [
-    "MeshAxes", "make_hybrid_mesh", "make_mesh",
+    "MeshAxes", "make_hybrid_mesh", "make_mesh", "surviving_mesh_shape",
     "ShardingStrategy", "param_specs", "shard_model",
     "ParallelTrainer", "ParallelWrapper", "TrainingMode",
     "blockwise_attention", "local_attention_reference",
@@ -24,6 +28,9 @@ __all__ = [
     "global_mesh", "initialize", "is_multi_host", "local_batch_slice",
     "process_index",
     "ShardedCheckpoint", "restore_sharded", "save_sharded",
+    "CoordinatedShardStore", "ElasticWorkerLost",
+    "CoordinatedCheckpoint", "DrainSignal", "ElasticTrainer",
+    "HeartbeatLease",
     "ZeroConfig", "assign_buckets", "collective_overlap_fraction",
     "make_zero_accum_superstep", "make_zero_step", "zero_grad_specs",
     "zero_opt_shardings",

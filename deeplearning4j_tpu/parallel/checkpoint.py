@@ -14,6 +14,19 @@ marker-less directory that `latest_step` skips and `_gc` sweeps, so
 falling further back if a committed step still fails to load (disk-level
 corruption). Retention keeps the newest `keep` committed steps plus the
 best-scoring one.
+
+**Coordinated snapshots (ISSUE 19)**: `CoordinatedShardStore` is the
+multi-worker two-phase-commit layer under `parallel/elastic.py` — every
+worker writes its own byte-range shard of every leaf (one raw blob + a
+sha256-per-slice manifest), marks itself DURABLE, and worker 0 writes the
+COMMIT marker only after verifying *all* workers' durable markers. The
+protocol synchronizes through the shared checkpoint directory (poll +
+deadline), never through a collective: a worker that dies mid-commit makes
+the survivors *time out and abort the step* (`ElasticWorkerLost`) instead
+of deadlocking in an allreduce, and the last committed step stays intact.
+Restore is mesh-shape-agnostic by construction — shards are flat byte
+ranges of the *logical* (model-level) trees, so any worker count/mesh
+factorization can reassemble and re-land them.
 """
 from __future__ import annotations
 
@@ -21,19 +34,30 @@ import json
 import logging
 import os
 import re
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 
-from ..fault.atomic import (read_commit_marker, write_commit_marker)
+from ..fault.atomic import (COMMIT_MARKER, CorruptCheckpointError,
+                            atomic_replace, read_commit_marker, sha256_hex,
+                            write_commit_marker)
 from ..fault.injection import fire_crash_point
 from ..fault.metrics import checkpoint_timer
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["save_sharded", "restore_sharded", "ShardedCheckpoint"]
+__all__ = ["save_sharded", "restore_sharded", "ShardedCheckpoint",
+           "CoordinatedShardStore", "ElasticWorkerLost"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class ElasticWorkerLost(RuntimeError):
+    """A peer worker failed to reach a two-phase-commit boundary (or the
+    COMMIT marker never appeared) within the deadline — it is presumed
+    dead/preempted. The snapshot step is left uncommitted; callers fall
+    back to the last committed step and resize."""
 
 
 def _checkpointer():
@@ -105,10 +129,11 @@ class ShardedCheckpoint:
     retention (newest `keep` + best score) and corrupt-step fallback."""
 
     def __init__(self, directory: str, keep: int = 3,
-                 keep_best: bool = True):
+                 keep_best: bool = True, commit_timeout_s: float = 60.0):
         self.directory = os.path.abspath(directory)
         self.keep = max(1, int(keep))
         self.keep_best = bool(keep_best)
+        self.commit_timeout_s = float(commit_timeout_s)
         # steps THIS manager attempted to save: an uncommitted one of
         # these is a crashed save and safe to sweep. Marker-less dirs we
         # did not write may be a pre-COMMIT-marker layout — never deleted
@@ -145,11 +170,34 @@ class ShardedCheckpoint:
         self._attempted.add(int(step))
         save_sharded(d, model, extra=extra)
         fire_crash_point("sharded/tree_written", path=d, step=step)
-        # process 0 writes meta.json/config.json in save_sharded, so only
-        # it may declare the step committed (a marker from another process
-        # could land before — or without — the metadata existing) or GC
-        if jax.process_index() == 0:
-            commit = {"step": int(step)}
+        # two-phase commit (ISSUE 19, replacing the old process-0 gate):
+        # orbax returns per-process once the LOCAL shards are down, so
+        # each process marks itself DURABLE and process 0 commits only
+        # after seeing every marker — a peer that died mid-save can no
+        # longer race process 0 into committing a step missing that
+        # peer's shards. Single-process degrades to marker-then-commit.
+        n = jax.process_count()
+        pid = jax.process_index()
+        atomic_replace(os.path.join(d, f"DURABLE_p{pid}"),
+                       json.dumps({"process": pid, "step": int(step)}
+                                  ).encode())
+        if pid == 0:
+            deadline = time.monotonic() + self.commit_timeout_s
+            missing = list(range(n))
+            while missing:
+                missing = [
+                    w for w in missing
+                    if not os.path.exists(os.path.join(d, f"DURABLE_p{w}"))]
+                if not missing:
+                    break
+                if time.monotonic() >= deadline:
+                    raise ElasticWorkerLost(
+                        f"sharded checkpoint step {step}: process(es) "
+                        f"{missing} never reached DURABLE within "
+                        f"{self.commit_timeout_s:.1f}s — step left "
+                        "uncommitted")
+                time.sleep(0.02)
+            commit = {"step": int(step), "n_processes": n}
             if score is not None:
                 commit["score"] = float(score)
             write_commit_marker(d, commit)
@@ -230,3 +278,266 @@ class ShardedCheckpoint:
             if (s not in committed and s in self._attempted
                     and newest is not None and s < newest):
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
+# ----------------------------------------------------------------------
+# coordinated multi-worker snapshots (two-phase commit; ISSUE 19)
+# ----------------------------------------------------------------------
+
+def _np_dtype(name: str):
+    """Resolve a dtype name back to numpy, including the ml_dtypes
+    extension types (bfloat16 etc.) jax arrays may carry."""
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _host_leaf(a):
+    """Host numpy copy of one leaf. A non-fully-addressable jax.Array
+    (multi-process sharded layout) is re-landed replicated through an
+    SPMD identity first — the reverse of the `parallel/param_placement`
+    placement jit `_prepare` uses."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from ..telemetry.compile_watch import watch_compiles
+        repl = NamedSharding(a.sharding.mesh, P())
+        a = watch_compiles(jax.jit(lambda x: x, out_shardings=repl),
+                           "parallel/host_gather")(a)
+    return np.asarray(a)
+
+
+def _leaf_items(tree):
+    """Deterministically-ordered (path-key, leaf) pairs of a pytree —
+    the shard schedule every worker derives independently (same tree =>
+    same keys => same byte-range assignment)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _worker_slice(n: int, worker: int, n_workers: int) -> slice:
+    """Worker `worker`'s byte-range slice of a flat leaf of `n` elements:
+    contiguous [w*n/W, (w+1)*n/W) ranges. Mesh-shape-independent — the
+    assignment depends only on (leaf size, worker count), so a snapshot
+    written under one (d, m, p) factorization reassembles under any
+    other."""
+    lo = (worker * n) // n_workers
+    hi = ((worker + 1) * n) // n_workers
+    return slice(lo, hi)
+
+
+class CoordinatedShardStore:
+    """One coordinated snapshot directory with a two-phase commit.
+
+    Layout (all files land via `atomic_replace`):
+
+      ``shards_p{w}.bin``     worker w's concatenated raw byte-range
+                              slices of every leaf (flat C-order)
+      ``manifest_p{w}.json``  per-slice sha256 + blob offsets + leaf
+                              shapes/dtypes/global offsets
+      ``meta.json``           worker 0's logical metadata (iteration,
+                              rng chain, n_workers, strategy, ...)
+      ``DURABLE_p{w}``        phase 1: worker w's payload is on disk
+                              (content = its manifest's sha256)
+      ``COMMIT``              phase 2: worker 0, only after verifying
+                              every worker's DURABLE marker
+
+    Synchronization is file-based (poll + deadline) rather than a
+    collective: the commit path must survive exactly the event it
+    protects against — a peer dying mid-protocol — without deadlocking
+    the survivors.
+
+    Crash points (fault/injection.py), one per commit boundary:
+      ``elastic/shards_written``  payload + manifest down, DURABLE not
+                                  yet (shard-durable-but-unmarked)
+      ``elastic/durable_marked``  between phase 1 and phase 2
+      ``elastic/commit_marker``   inside the COMMIT marker's atomic
+                                  write (temp bytes, no rename: a torn
+                                  marker is invisible to readers)
+    """
+
+    def __init__(self, directory: str, n_workers: int = 1,
+                 worker_id: int = 0, commit_timeout_s: float = 60.0,
+                 poll_s: float = 0.02):
+        self.directory = os.path.abspath(directory)
+        self.n_workers = max(1, int(n_workers))
+        self.worker_id = int(worker_id)
+        self.commit_timeout_s = float(commit_timeout_s)
+        self.poll_s = float(poll_s)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+    def _blob_path(self, w: int) -> str:
+        return os.path.join(self.directory, f"shards_p{w}.bin")
+
+    def _manifest_path(self, w: int) -> str:
+        return os.path.join(self.directory, f"manifest_p{w}.json")
+
+    def _durable_path(self, w: int) -> str:
+        return os.path.join(self.directory, f"DURABLE_p{w}")
+
+    # -- phase 1: every worker ----------------------------------------
+    def write_shards(self, tree, meta: Optional[Dict] = None,
+                     worker_id: Optional[int] = None):
+        """Write THIS worker's byte-range slices of every leaf + the
+        sha256 manifest, then mark the worker DURABLE. `worker_id`
+        overrides the store's own id so a single process can emulate
+        every worker of the protocol (the tier-1 reshape suite)."""
+        import numpy as np
+
+        w = self.worker_id if worker_id is None else int(worker_id)
+        chunks: List[bytes] = []
+        leaves = []
+        off = 0
+        for key, leaf in _leaf_items(tree):
+            arr = _host_leaf(leaf)
+            flat = np.ravel(arr)
+            sl = _worker_slice(flat.size, w, self.n_workers)
+            blob = np.ascontiguousarray(flat[sl]).tobytes()
+            leaves.append({
+                "key": key, "shape": list(arr.shape),
+                "dtype": str(arr.dtype), "global_offset": int(sl.start),
+                "n": int(sl.stop - sl.start), "blob_offset": off,
+                "nbytes": len(blob), "sha256": sha256_hex(blob)})
+            chunks.append(blob)
+            off += len(blob)
+        payload = b"".join(chunks)
+        atomic_replace(self._blob_path(w), payload)
+        manifest = {"worker": w, "n_workers": self.n_workers,
+                    "blob_sha256": sha256_hex(payload), "leaves": leaves}
+        mbytes = json.dumps(manifest, sort_keys=True).encode()
+        atomic_replace(self._manifest_path(w), mbytes)
+        if w == 0 and meta is not None:
+            atomic_replace(os.path.join(self.directory, "meta.json"),
+                           json.dumps(meta, sort_keys=True).encode())
+        fire_crash_point("elastic/shards_written", path=self.directory,
+                         worker=w)
+        atomic_replace(self._durable_path(w),
+                       json.dumps({"worker": w,
+                                   "manifest_sha256": sha256_hex(mbytes)
+                                   }).encode())
+        fire_crash_point("elastic/durable_marked", path=self.directory,
+                         worker=w)
+
+    # -- phase 2: worker 0 --------------------------------------------
+    def commit(self, extra: Optional[Dict] = None):
+        """Worker 0: wait (bounded) for every worker's DURABLE marker,
+        verify each against its manifest, then write COMMIT. A missing
+        peer marker past the deadline raises ElasticWorkerLost and the
+        step stays uncommitted — a torn snapshot is never served."""
+        deadline = time.monotonic() + self.commit_timeout_s
+        missing = list(range(self.n_workers))
+        while missing:
+            missing = [w for w in missing
+                       if not os.path.exists(self._durable_path(w))]
+            if not missing:
+                break
+            if time.monotonic() >= deadline:
+                raise ElasticWorkerLost(
+                    f"coordinated snapshot {self.directory}: worker(s) "
+                    f"{missing} never reached DURABLE within "
+                    f"{self.commit_timeout_s:.1f}s — presumed lost; step "
+                    "left uncommitted")
+            time.sleep(self.poll_s)
+        for w in range(self.n_workers):
+            with open(self._durable_path(w), "rb") as f:
+                marker = json.loads(f.read().decode())
+            with open(self._manifest_path(w), "rb") as f:
+                mbytes = f.read()
+            if marker.get("manifest_sha256") != sha256_hex(mbytes):
+                raise CorruptCheckpointError(
+                    f"worker {w} DURABLE marker does not match its "
+                    f"manifest under {self.directory}")
+        commit = {"n_workers": self.n_workers}
+        if extra:
+            commit.update(extra)
+        atomic_replace(os.path.join(self.directory, COMMIT_MARKER),
+                       json.dumps(commit, sort_keys=True).encode(),
+                       crash_point="elastic/commit_marker")
+
+    def wait_committed(self):
+        """Non-zero workers: block (bounded) until worker 0's COMMIT
+        marker appears. Times out into ElasticWorkerLost — worker 0
+        dying mid-commit must not wedge the survivors."""
+        deadline = time.monotonic() + self.commit_timeout_s
+        while read_commit_marker(self.directory) is None:
+            if time.monotonic() >= deadline:
+                raise ElasticWorkerLost(
+                    f"coordinated snapshot {self.directory}: COMMIT "
+                    f"never appeared within {self.commit_timeout_s:.1f}s "
+                    "— worker 0 presumed lost")
+            time.sleep(self.poll_s)
+
+    # -- restore -------------------------------------------------------
+    def committed(self) -> bool:
+        return read_commit_marker(self.directory) is not None
+
+    def read_meta(self) -> Dict:
+        with open(os.path.join(self.directory, "meta.json")) as f:
+            return json.load(f)
+
+    def read_tree(self, template):
+        """Reassemble the full logical tree from every saver's shards,
+        verifying each slice's sha256. `template` supplies the pytree
+        structure (the restoring model's own trees — any mesh shape);
+        leaf count and shapes must match the manifests or the snapshot
+        is rejected (CorruptCheckpointError)."""
+        import numpy as np
+
+        marker = read_commit_marker(self.directory)
+        if marker is None:
+            raise CorruptCheckpointError(
+                f"{self.directory} has no COMMIT marker (crashed save)")
+        n_savers = int(marker.get("n_workers", self.n_workers))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        keys = [jax.tree_util.keystr(path) for path, _ in flat]
+        parts: Dict[str, list] = {k: [] for k in keys}
+        shapes: Dict[str, tuple] = {}
+        dtypes: Dict[str, Any] = {}
+        for w in range(n_savers):
+            try:
+                with open(self._manifest_path(w), "rb") as f:
+                    manifest = json.loads(f.read().decode())
+                with open(self._blob_path(w), "rb") as f:
+                    blob = f.read()
+            except (OSError, ValueError) as e:
+                raise CorruptCheckpointError(
+                    f"worker {w} shards unreadable under "
+                    f"{self.directory}: {e}") from e
+            saved_keys = [ent["key"] for ent in manifest["leaves"]]
+            if saved_keys != keys:
+                raise CorruptCheckpointError(
+                    f"snapshot tree structure mismatch under "
+                    f"{self.directory}: saved {len(saved_keys)} leaves, "
+                    f"restore template has {len(keys)}")
+            for ent in manifest["leaves"]:
+                raw = blob[ent["blob_offset"]:
+                           ent["blob_offset"] + ent["nbytes"]]
+                if sha256_hex(raw) != ent["sha256"]:
+                    raise CorruptCheckpointError(
+                        f"sha256 mismatch for leaf {ent['key']} slice of "
+                        f"worker {w} under {self.directory}")
+                dt = _np_dtype(ent["dtype"])
+                parts[ent["key"]].append(
+                    (ent["global_offset"], np.frombuffer(raw, dtype=dt)))
+                shapes[ent["key"]] = tuple(ent["shape"])
+                dtypes[ent["key"]] = dt
+        out = []
+        for (path, leaf), key in zip(flat, keys):
+            shape = shapes[key]
+            n = int(np.prod(shape)) if shape else 1
+            full = np.empty(n, dtype=dtypes[key])
+            covered = 0
+            for off, piece in sorted(parts[key], key=lambda t: t[0]):
+                full[off:off + piece.size] = piece
+                covered += piece.size
+            if covered != n:
+                raise CorruptCheckpointError(
+                    f"leaf {key} reassembled {covered}/{n} elements "
+                    f"under {self.directory}")
+            out.append(full.reshape(shape))
+        return jax.tree_util.tree_unflatten(treedef, out)

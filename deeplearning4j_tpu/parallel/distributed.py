@@ -9,11 +9,18 @@ is only used for process rendezvous and the dataset plane.
 
 Degrades gracefully to single-process (the CI/local case): `initialize()` is
 a no-op when no coordinator is configured.
+
+Elastic rendezvous (ISSUE 19): preempted/restarted workers re-join through
+the same `initialize()` — the coordinator may still be tearing down the old
+generation or not be up yet, so the call retries with bounded exponential
+backoff (the fault/ retry policy) instead of failing a whole generation on
+one connection race.
 """
 from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Dict, Optional
 
 import jax
@@ -25,20 +32,59 @@ log = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["initialize", "is_multi_host", "global_mesh", "process_index",
            "local_batch_slice", "allreduce_evaluation", "allgather_rows"]
 
+# patchable in tests (backoff without wall-clock sleeps)
+_sleep = time.sleep
+
+#: transient rendezvous failures worth retrying: the coordinator not up
+#: yet / mid-teardown surfaces as RuntimeError (gRPC DEADLINE_EXCEEDED /
+#: UNAVAILABLE wrapped by jaxlib) or a raw socket error
+_RETRYABLE = (RuntimeError, ConnectionError, OSError, TimeoutError)
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None):
+               process_id: Optional[int] = None,
+               max_retries: int = 4,
+               backoff_base_s: float = 0.5,
+               backoff_cap_s: float = 8.0):
     """Initialize multi-host JAX. No-op when single-process (no coordinator
-    configured via args or JAX_COORDINATOR_ADDRESS env)."""
+    configured via args or JAX_COORDINATOR_ADDRESS env).
+
+    Rendezvous retries up to `max_retries` times on transient failures
+    with bounded exponential backoff (base * 2^attempt, capped), counting
+    each retry into ``dl4j_fault_retries_total{kind=rendezvous}``. After
+    the budget is spent it raises a RuntimeError naming the coordinator
+    address and the usual causes, chained to the last underlying error."""
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if addr is None:
         log.debug("distributed.initialize: single-process mode")
         return False
-    jax.distributed.initialize(coordinator_address=addr,
-                               num_processes=num_processes,
-                               process_id=process_id)
-    return True
+    from ..fault.metrics import count_retry
+
+    last = None
+    for attempt in range(int(max_retries) + 1):
+        if attempt:
+            delay = min(backoff_base_s * (2 ** (attempt - 1)), backoff_cap_s)
+            log.warning(
+                "distributed.initialize: rendezvous with %s failed (%s); "
+                "retry %d/%d in %.1fs", addr, last, attempt, max_retries,
+                delay)
+            count_retry("rendezvous")
+            _sleep(delay)
+        try:
+            jax.distributed.initialize(coordinator_address=addr,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+            return True
+        except _RETRYABLE as e:
+            last = e
+    raise RuntimeError(
+        f"could not rendezvous with the JAX distributed coordinator at "
+        f"{addr} after {int(max_retries) + 1} attempt(s). Check that the "
+        f"coordinator process (process_id=0) is running and reachable at "
+        f"that address/port, that num_processes ({num_processes}) matches "
+        f"the launched world size, and that no stale generation still "
+        f"holds the port.") from last
 
 
 def is_multi_host() -> bool:

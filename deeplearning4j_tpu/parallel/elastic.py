@@ -1,0 +1,645 @@
+"""Elastic, preemption-tolerant multi-host training (ISSUE 19).
+
+DL4J's `ParallelWrapper`/Spark stack assumed a resilient cluster substrate
+(executor supervision, driver-side retries); preemptible TPU fleets have
+none, so this module builds the supervision plane on top of
+`ParallelTrainer`:
+
+  * **CoordinatedCheckpoint** — step-directory manager over
+    `parallel/checkpoint.py`'s `CoordinatedShardStore`: every worker
+    writes its own sha256-manifested byte-range shards of the *logical*
+    (mesh-shape-independent) training state; a two-phase commit (all
+    workers DURABLE -> worker-0 COMMIT) replaces the process-0 gate, and
+    restore reassembles + re-lands the layouts on ANY (d, m, p)
+    factorization via `ParallelTrainer.load_elastic_state`.
+  * **HeartbeatLease** — shared-directory worker liveness: each worker
+    atomically renews ``lease_p{w}.json``; a lease older than the TTL is
+    a lost worker (dead and wedged hosts look identical from outside).
+  * **DrainSignal** — cross-process SIGTERM-window draining: the first
+    preempted worker publishes the superstep edge it will drain at; every
+    worker observes the signal at its next edge check and snapshots at
+    the SAME edge before exiting, so the fleet lands one consistent
+    coordinated snapshot instead of N ragged ones.
+  * **ElasticTrainer** — the supervision loop: renew lease -> check
+    drain/loss/join -> train one step -> snapshot at edges. Worker loss
+    or join triggers a deterministic resize: re-form the mesh on the
+    surviving (d, m, p) factorization (`surviving_mesh_shape`), rebuild
+    the `ParallelTrainer`, restore the last committed snapshot, and
+    replay from its edge. Determinism contract: the data schedule is
+    keyed on the global step ordinal (``batch_fn(step)``) and the
+    per-batch RNG chain is split once per optimizer step independent of
+    mesh shape — so any resize resumes bit-exactly from the last edge.
+
+Two worlds, one protocol:
+
+  * **real multi-process** (``jax.distributed``): each process runs one
+    ElasticTrainer with its own ``worker_id``. Loss of a peer cannot be
+    survived in-place (the jax.distributed world size is fixed at
+    initialize), so the loop exits with status ``"worker_lost"`` and the
+    launcher re-rendezvouses a new generation (see
+    `tests/_dist_child.py`'s drill mode) — the two-phase commit
+    guarantees the new generation restores an untorn snapshot.
+  * **single-process emulation** (``emulated=True``): one process owns
+    all devices, carves them into ``n_workers x devices_per_worker``,
+    runs the FULL multi-writer two-phase commit itself (one
+    ``write_shards`` per live worker) and resizes in-place — the tier-1
+    test surface for the protocol and the reshape-restore contract.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import re
+import signal
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..fault.atomic import atomic_replace, read_commit_marker
+from ..fault.injection import STEP_POINT, fire_crash_point
+from ..fault.metrics import count_elastic, elastic_snapshot_timer
+from .checkpoint import (CoordinatedShardStore, ElasticWorkerLost)
+from .mesh import MeshAxes, make_mesh, surviving_mesh_shape
+from .sharding import ShardingStrategy
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["HeartbeatLease", "DrainSignal", "CoordinatedCheckpoint",
+           "ElasticTrainer", "ElasticWorkerLost", "surviving_mesh_shape"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class HeartbeatLease:
+    """Worker liveness through a shared directory: worker w atomically
+    renews ``lease_p{w}.json`` (wall-clock stamp — comparable across
+    processes on a shared filesystem, unlike monotonic clocks); a lease
+    older than ``ttl_s`` marks its worker LOST. A clean leave deletes
+    the lease (`resign`), distinguishing planned drains from deaths.
+    `clock` is injectable so tests can expire leases without sleeping."""
+
+    def __init__(self, directory: str, worker_id: int, ttl_s: float = 5.0,
+                 clock: Callable[[], float] = time.time):
+        self.directory = os.path.abspath(directory)
+        self.worker_id = int(worker_id)
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        self._renewals = 0
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, w: int) -> str:
+        return os.path.join(self.directory, f"lease_p{w}.json")
+
+    def renew(self, worker_id: Optional[int] = None):
+        w = self.worker_id if worker_id is None else int(worker_id)
+        self._renewals += 1
+        atomic_replace(self._path(w), json.dumps(
+            {"worker": w, "t": self.clock(),
+             "n": self._renewals}).encode())
+
+    def resign(self, worker_id: Optional[int] = None):
+        w = self.worker_id if worker_id is None else int(worker_id)
+        try:
+            os.unlink(self._path(w))
+        except OSError:
+            pass
+
+    def ages(self) -> Dict[int, float]:
+        """{worker_id: seconds since last renewal} for every lease file
+        present (unreadable/torn files count as infinitely old)."""
+        now = self.clock()
+        out: Dict[int, float] = {}
+        for name in os.listdir(self.directory):
+            m = re.match(r"^lease_p(\d+)\.json$", name)
+            if not m:
+                continue
+            w = int(m.group(1))
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    out[w] = now - float(json.load(f)["t"])
+            except (OSError, ValueError, KeyError):
+                out[w] = float("inf")
+        return out
+
+    def active_workers(self) -> List[int]:
+        """Workers with a fresh lease (age <= ttl), sorted."""
+        return sorted(w for w, age in self.ages().items()
+                      if age <= self.ttl_s)
+
+    def lost_workers(self, expected: Sequence[int]) -> List[int]:
+        """Members of `expected` whose lease is stale or missing."""
+        ages = self.ages()
+        return sorted(w for w in expected
+                      if ages.get(w, float("inf")) > self.ttl_s)
+
+
+class DrainSignal:
+    """The cross-process drain handshake: the FIRST preempted worker
+    publishes the superstep edge it will drain at (``DRAIN.json``,
+    atomic; first writer wins — later requests join the earlier edge if
+    it is still ahead). Every worker polls `target_edge` at its own edge
+    checks; all land the same edge, coordinated-snapshot there, and
+    exit."""
+
+    FILENAME = "DRAIN.json"
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    @property
+    def _path(self) -> str:
+        return os.path.join(self.directory, self.FILENAME)
+
+    def request(self, edge: int, worker_id: int) -> int:
+        """Request a drain at step-edge `edge`; returns the WINNING edge
+        (an earlier request's edge may already be published and still
+        ahead of the caller — everyone converges on one edge)."""
+        cur = self.target_edge()
+        if cur is not None:
+            return cur
+        atomic_replace(self._path, json.dumps(
+            {"edge": int(edge), "worker": int(worker_id),
+             "t": time.time()}).encode())
+        return self.target_edge() or int(edge)
+
+    def target_edge(self) -> Optional[int]:
+        try:
+            with open(self._path) as f:
+                return int(json.load(f)["edge"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def clear(self):
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+
+class CoordinatedCheckpoint:
+    """Step-directory manager over `CoordinatedShardStore`: the elastic
+    analog of `ShardedCheckpoint`, holding one two-phase-committed
+    snapshot of the trainer's LOGICAL state per ``step_NNNNNNNNN``
+    directory. Restore walks committed steps newest-first and falls back
+    on any snapshot that fails sha256/assembly verification."""
+
+    def __init__(self, directory: str, n_workers: int = 1,
+                 worker_id: int = 0, keep: int = 3,
+                 commit_timeout_s: float = 60.0):
+        self.directory = os.path.abspath(directory)
+        self.n_workers = max(1, int(n_workers))
+        self.worker_id = int(worker_id)
+        self.keep = max(1, int(keep))
+        self.commit_timeout_s = float(commit_timeout_s)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:09d}")
+
+    def _store(self, step: int) -> CoordinatedShardStore:
+        return CoordinatedShardStore(
+            self._step_dir(step), n_workers=self.n_workers,
+            worker_id=self.worker_id,
+            commit_timeout_s=self.commit_timeout_s)
+
+    def steps(self) -> List[int]:
+        """Committed steps, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and read_commit_marker(
+                    os.path.join(self.directory, name)) is not None:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------------
+    def save(self, trainer, extra: Optional[Dict] = None,
+             wait_commit: bool = True,
+             emulate_workers: Optional[Sequence[int]] = None) -> int:
+        """Coordinated snapshot of `trainer` at its current step. Real
+        multi-process: this worker writes ITS shards; worker 0 then
+        commits, others wait for the marker (bounded — worker death
+        mid-protocol times out into ElasticWorkerLost, never a torn
+        snapshot and never a deadlock). `emulate_workers` lists ALL
+        worker ids this single process should write as (the emulation
+        world), worker 0 last so its commit still follows every durable
+        marker."""
+        step = int(trainer.iteration_count)
+        store = self._store(step)
+        tree, meta = trainer.elastic_state()
+        meta["n_workers"] = self.n_workers
+        if extra:
+            meta.update(extra)
+        with elastic_snapshot_timer():
+            if emulate_workers is not None:
+                for w in sorted(emulate_workers, reverse=True):
+                    store.write_shards(tree, meta=meta, worker_id=w)
+            else:
+                store.write_shards(tree, meta=meta)
+            if self.worker_id == 0:
+                store.commit(extra={"step": step})
+                self._gc()
+            elif wait_commit:
+                store.wait_committed()
+        return step
+
+    def restore(self, trainer) -> Optional[int]:
+        """Restore the newest committed snapshot into `trainer` (any
+        mesh shape — `load_elastic_state` re-lands the layouts), falling
+        back to older committed steps if one fails verification.
+        Returns the restored step, or None when nothing committed."""
+        for step in reversed(self.steps()):
+            store = self._store(step)
+            try:
+                meta = store.read_meta()
+                tree = store.read_tree(
+                    {"params": trainer.model.params,
+                     "state": trainer.model.state,
+                     "updater_state": trainer.model.updater_state})
+                trainer.load_elastic_state(tree, meta)
+                return step
+            except Exception as e:
+                log.warning(
+                    "coordinated snapshot step %d unusable (%s: %s) — "
+                    "falling back to an older step", step,
+                    type(e).__name__, e)
+        return None
+
+    def meta(self, step: int) -> Optional[Dict]:
+        try:
+            return self._store(step).read_meta()
+        except (OSError, ValueError):
+            return None
+
+    def _gc(self):
+        import shutil
+
+        committed = self.steps()
+        for s in committed[:-self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
+def _strategy_for_shape(strategy: str, shape: Sequence[int]):
+    """Deterministic strategy downgrade when a resize collapses an axis:
+    the pipeline strategies need pipe >= 2, so a (d, m, 1) survivor
+    re-lands as the matching 2-D strategy — the checkpoint is logical
+    (per-layer trees), so the cross-strategy restore is exact."""
+    shape = tuple(shape)
+    if len(shape) == 3 and shape[2] == 1:
+        if strategy == ShardingStrategy.ZERO1_TP_PP:
+            return ShardingStrategy.ZERO1_TP, shape[:2]
+        if strategy == ShardingStrategy.PP:
+            return ShardingStrategy.REPLICATED, shape[:2]
+        return strategy, shape[:2]
+    return strategy, shape
+
+
+class ElasticTrainer:
+    """Supervision loop wrapping `ParallelTrainer` with heartbeat-lease
+    liveness, coordinated edge snapshots, cross-process draining and
+    deterministic resize (see module docstring for the full contract).
+
+    `model_factory` must return a freshly-initialized model each call —
+    every resize builds a new model + trainer and restores the last
+    committed snapshot into it. `batch_fn(step)` (or a list indexed by
+    step) must be deterministic in the GLOBAL step ordinal: that, plus
+    the snapshot-carried RNG chain, is what makes resume bit-exact on
+    any mesh reshape.
+
+    ``snapshot_every`` sets the superstep-edge cadence (a snapshot edge
+    at every multiple). Worker loss costs at most ``snapshot_every - 1``
+    replayed steps.
+    """
+
+    def __init__(self, model_factory: Callable, directory: str, *,
+                 mesh_shape: Optional[Sequence[int]] = None,
+                 strategy: str = ShardingStrategy.REPLICATED,
+                 n_workers: Optional[int] = None,
+                 worker_id: Optional[int] = None,
+                 devices_per_worker: Optional[int] = None,
+                 emulated: Optional[bool] = None,
+                 snapshot_every: int = 1, keep: int = 3,
+                 lease_ttl_s: float = 5.0,
+                 commit_timeout_s: float = 30.0,
+                 trainer_kwargs: Optional[Dict] = None,
+                 clock: Callable[[], float] = time.time):
+        self.model_factory = model_factory
+        self.directory = os.path.abspath(directory)
+        self.strategy = strategy
+        self.n_workers = (jax.process_count() if n_workers is None
+                          else max(1, int(n_workers)))
+        self.worker_id = (jax.process_index() if worker_id is None
+                          else int(worker_id))
+        # emulation: one process plays every worker (single-process world
+        # asked to behave as n_workers > its process count)
+        self.emulated = (jax.process_count() == 1 and self.n_workers > 1
+                         if emulated is None else bool(emulated))
+        if devices_per_worker is None:
+            devices_per_worker = max(1, len(jax.devices()) // self.n_workers)
+        self.devices_per_worker = int(devices_per_worker)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.keep = keep
+        self.commit_timeout_s = float(commit_timeout_s)
+        self.trainer_kwargs = dict(trainer_kwargs or {})
+        self.lease = HeartbeatLease(os.path.join(self.directory, "leases"),
+                                    self.worker_id, ttl_s=lease_ttl_s,
+                                    clock=clock)
+        self.drain = DrainSignal(self.directory)
+        self._live: List[int] = list(range(self.n_workers))
+        self._emulated_dead: set = set()
+        if mesh_shape is None:
+            mesh_shape = (self.n_workers * self.devices_per_worker, 1)
+        self._want_shape = tuple(int(v) for v in mesh_shape)
+        self._preempted = False
+        self._drain_edge: Optional[int] = None
+        self.trainer = None
+        self.mesh_shape: Optional[tuple] = None
+        self._rebuild(len(self._live))
+
+    # ------------------------------------------------------------------
+    @property
+    def checkpoint(self) -> CoordinatedCheckpoint:
+        """The step manager for the CURRENT live-worker set (the saver
+        count is part of the commit contract, so it re-forms per
+        resize). Real multi-process keeps the true worker id; emulation
+        is always 'worker 0 commits' with every live worker written
+        locally."""
+        return CoordinatedCheckpoint(
+            os.path.join(self.directory, "steps"),
+            n_workers=len(self._live),
+            worker_id=0 if self.emulated else self.worker_id,
+            keep=self.keep, commit_timeout_s=self.commit_timeout_s)
+
+    def _devices(self, n_live: int):
+        devs = jax.devices()
+        if self.emulated:
+            return devs[: n_live * self.devices_per_worker]
+        return devs
+
+    def _rebuild(self, n_live: int):
+        """(Re-)form the mesh on the surviving device set and build a
+        fresh ParallelTrainer — the resize half of elastic recovery; the
+        caller restores the last committed snapshot after."""
+        from .trainer import ParallelTrainer
+
+        devices = self._devices(n_live)
+        shape = surviving_mesh_shape(len(devices), self._want_shape)
+        strategy, shape = _strategy_for_shape(self.strategy, shape)
+        axes = {MeshAxes.DATA: shape[0], MeshAxes.MODEL: shape[1]}
+        if len(shape) == 3:
+            axes[MeshAxes.PIPE] = shape[2]
+        mesh = make_mesh(axes, devices=devices)
+        self.trainer = ParallelTrainer(self.model_factory(), mesh=mesh,
+                                       strategy=strategy,
+                                       **self.trainer_kwargs)
+        self.mesh_shape = shape
+        log.info("elastic: (re)formed mesh %s strategy=%s over %d "
+                 "device(s), %d live worker(s)", shape, strategy,
+                 len(devices), n_live)
+
+    # ------------------------------------------------------------------
+    def _snapshot(self, extra: Optional[Dict] = None) -> int:
+        ck = self.checkpoint
+        return ck.save(
+            self.trainer, extra=extra,
+            emulate_workers=list(range(len(self._live)))
+            if self.emulated else None)
+
+    def _restore(self) -> Optional[int]:
+        return self.checkpoint.restore(self.trainer)
+
+    def _next_edge(self, step: int) -> int:
+        """The first snapshot edge at or after `step` (edges are
+        multiples of snapshot_every; an edge at step k means 'k steps
+        trained')."""
+        k = self.snapshot_every
+        return ((step + k - 1) // k) * k
+
+    def _resize(self, n_live: int, *, event: str) -> None:
+        """Snapshot-restore resize onto `n_live` workers: the trainer is
+        rebuilt on the surviving factorization and the last committed
+        snapshot re-lands — steps past that edge replay deterministically
+        from `batch_fn`. Emulation renumbers the surviving workers to
+        0..n_live-1 (fresh leases, dead set cleared) — worker IDENTITY is
+        a launcher concern; the elastic contract is about the count."""
+        if self.emulated:
+            for w in list(self.lease.ages()):
+                self.lease.resign(w)
+            self._emulated_dead.clear()
+            for w in range(n_live):
+                self.lease.renew(w)
+        self._live = list(range(n_live))
+        self._rebuild(n_live)
+        restored = self._restore()
+        count_elastic("resizes")
+        log.warning("elastic: resized to %d worker(s) after %s; resumed "
+                    "from %s", n_live, event,
+                    f"step {restored}" if restored is not None
+                    else "initial state")
+
+    # -- real-mode step barrier ----------------------------------------
+    # A collective issued against a dead peer hangs until some distant
+    # runtime timeout; the supervision loop must find out FIRST. Before
+    # each optimizer step every worker announces its step ordinal to the
+    # shared directory and waits (bounded by the lease TTL) for every
+    # live peer to announce the same ordinal — a peer that died between
+    # the lease renewal and its announcement turns into a clean
+    # "worker_lost" exit instead of a wedged all-reduce.
+    def _announce(self, step: int):
+        atomic_replace(
+            os.path.join(self.lease.directory,
+                         f"ann_p{self.worker_id}.json"),
+            json.dumps({"worker": self.worker_id,
+                        "step": int(step)}).encode())
+
+    def _peer_step(self, w: int) -> int:
+        try:
+            with open(os.path.join(self.lease.directory,
+                                   f"ann_p{w}.json")) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            return -1
+
+    def _await_peers(self, step: int) -> List[int]:
+        """Wait until every live peer announced `step` (or later);
+        returns the peers that failed to show up within the lease TTL."""
+        peers = [w for w in self._live if w != self.worker_id]
+        deadline = time.monotonic() + self.lease.ttl_s
+        while time.monotonic() < deadline:
+            behind = [w for w in peers if self._peer_step(w) < step]
+            if not behind:
+                return []
+            time.sleep(0.01)
+        return [w for w in peers if self._peer_step(w) < step]
+
+    def mark_worker_lost(self, worker_id: int):
+        """Emulation hook: declare a worker dead — its lease drops and
+        stops being renewed, so the supervision loop detects the missing
+        lease and resizes down (exactly what a real worker's silence
+        looks like through the lease directory)."""
+        self._emulated_dead.add(int(worker_id))
+        self.lease.resign(worker_id)
+
+    def mark_worker_joined(self, worker_id: int):
+        """Emulation hook: a new/returning worker announces itself by
+        renewing a lease under its id; the loop resizes up at its next
+        liveness check."""
+        self._emulated_dead.discard(int(worker_id))
+        self.lease.renew(worker_id)
+
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def _sigterm_window(self):
+        """Defer SIGTERM to the next superstep edge (preemption notice):
+        the handler only sets a flag; the loop converts it into a
+        cross-process drain request at the next edge check. Re-raises
+        the default disposition after a drained exit so the launcher
+        still sees a terminated process."""
+        installed = False
+        prev = None
+
+        def handler(signum, frame):
+            self._preempted = True
+
+        try:
+            prev = signal.signal(signal.SIGTERM, handler)
+            installed = True
+        except ValueError:
+            pass  # non-main thread: drills drive _preempted directly
+        try:
+            yield
+        finally:
+            if installed:
+                signal.signal(signal.SIGTERM, prev or signal.SIG_DFL)
+
+    def fit(self, batch_fn, n_steps: int, *, resume: bool = True) -> str:
+        """Run the supervision loop until `n_steps` optimizer steps have
+        been trained (globally — a resumed/resized run continues the
+        count). Returns a status string:
+
+          ``"completed"``    n_steps trained; final edge snapshot taken
+          ``"drained"``      a preemption drain landed; all live workers
+                             snapshotted the same superstep edge
+          ``"worker_lost"``  (real multi-process only) a peer died; the
+                             last committed snapshot is intact and a new
+                             generation should re-rendezvous
+        """
+        if isinstance(batch_fn, (list, tuple)):
+            batches = batch_fn
+            batch_fn = lambda step: batches[step % len(batches)]
+        if resume:
+            restored = self.checkpoint.restore(self.trainer)
+            if restored is not None:
+                meta = self.checkpoint.meta(restored) or {}
+                savers = int(meta.get("n_workers", len(self._live)))
+                if savers != len(self._live):
+                    count_elastic("resizes")
+                    if savers < len(self._live):
+                        count_elastic("rejoins")
+                    log.info(
+                        "elastic: restored step %d written by %d "
+                        "worker(s) onto %d live worker(s)", restored,
+                        savers, len(self._live))
+        stale = self.drain.target_edge()
+        if stale is not None and self.trainer.iteration_count >= stale:
+            # the previous generation's drain already landed its edge (we
+            # restored at/past it) — a new generation starts clean
+            self.drain.clear()
+            self._preempted = False
+            self._drain_edge = None
+        with self._sigterm_window():
+            try:
+                return self._fit_loop(batch_fn, n_steps)
+            except ElasticWorkerLost as e:
+                count_elastic("worker_losses")
+                log.error(
+                    "elastic: peer lost during coordinated snapshot (%s) "
+                    "— exiting for generation restart; last committed "
+                    "step %s", e, self.checkpoint.latest_step())
+                self.lease.resign()
+                return "worker_lost"
+
+    def _fit_loop(self, batch_fn, n_steps: int) -> str:
+            while self.trainer.iteration_count < n_steps:
+                step = self.trainer.iteration_count
+                if self.emulated:
+                    # one process plays every live worker's heartbeat
+                    for w in self._live:
+                        if w not in self._emulated_dead:
+                            self.lease.renew(w)
+                else:
+                    self.lease.renew()
+                fire_crash_point(STEP_POINT, step=step,
+                                 worker=self.worker_id)
+                # -- drain handshake (at every step boundary) ----------
+                if self._preempted and self._drain_edge is None:
+                    self._drain_edge = self.drain.request(
+                        self._next_edge(step), self.worker_id)
+                    count_elastic("drains")
+                    log.warning("elastic: preemption notice — draining "
+                                "at edge %d", self._drain_edge)
+                target = self.drain.target_edge()
+                if target is not None and step >= target:
+                    self._snapshot(extra={"drained": True})
+                    self.lease.resign()
+                    return "drained"
+                # -- liveness ------------------------------------------
+                if self.emulated:
+                    active = self.lease.active_workers() or [self.worker_id]
+                    lost = [w for w in self._live if w not in active]
+                    if lost:
+                        count_elastic("worker_losses", len(lost))
+                        self._resize(len(active),
+                                     event=f"loss of worker(s) {lost}")
+                        continue
+                    if len(active) > len(self._live):
+                        count_elastic(
+                            "rejoins", len(active) - len(self._live))
+                        if step > (self.checkpoint.latest_step() or -1):
+                            self._snapshot()
+                        self._resize(len(active), event="worker join")
+                        continue
+                else:
+                    lost = self.lease.lost_workers(
+                        [w for w in self._live if w != self.worker_id])
+                    if lost:
+                        count_elastic("worker_losses", len(lost))
+                        log.error(
+                            "elastic: worker(s) %s lost (stale lease) — "
+                            "exiting for generation restart; last "
+                            "committed step %s", lost,
+                            self.checkpoint.latest_step())
+                        self.lease.resign()
+                        return "worker_lost"
+                    # a peer that died AFTER its lease renewal would
+                    # wedge the step's first collective: barrier on the
+                    # step announcement before dispatching
+                    if len(self._live) > 1:
+                        self._announce(step)
+                        behind = self._await_peers(step)
+                        if behind:
+                            count_elastic("worker_losses", len(behind))
+                            log.error(
+                                "elastic: worker(s) %s never announced "
+                                "step %d — exiting for generation "
+                                "restart; last committed step %s", behind,
+                                step, self.checkpoint.latest_step())
+                            self.lease.resign()
+                            return "worker_lost"
+                # -- one optimizer step --------------------------------
+                self.trainer.fit(batch_fn(step))
+                if self.trainer.iteration_count % self.snapshot_every == 0:
+                    self._snapshot()
+            if self.trainer.iteration_count % self.snapshot_every:
+                self._snapshot()
+            self.lease.resign()
+            return "completed"

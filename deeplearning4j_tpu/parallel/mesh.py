@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "make_hybrid_mesh", "replicated", "data_sharding",
-           "MeshAxes"]
+           "surviving_mesh_shape", "MeshAxes"]
 
 
 class MeshAxes:
@@ -55,6 +55,45 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
         raise ValueError(f"Mesh {axes} needs {total} devices, have {n}")
     arr = np.array(devices).reshape(tuple(axes.values()))
     return Mesh(arr, axis_names=tuple(axes.keys()))
+
+
+def surviving_mesh_shape(n_devices: int, want: Sequence[int]) -> tuple:
+    """Deterministic re-factorization of a (d, m[, p]) mesh shape onto
+    `n_devices` surviving devices (elastic resize after worker loss/join,
+    ISSUE 19). Every worker computes the same answer from the same
+    (device count, desired shape) inputs — no negotiation round needed.
+
+    Preference order: keep the MODEL axis (re-sharding TP params moves
+    the most bytes on re-land), then the PIPE depth, and give the
+    remainder to DATA. Each kept axis must divide both the survivor
+    count and its original size (axes shrink by whole factors only, so
+    e.g. TP groups stay aligned). 1 always divides, so a factorization
+    always exists; d may shrink OR grow (a rejoin).
+
+      surviving_mesh_shape(8, (2, 2, 2)) == (2, 2, 2)   # unchanged
+      surviving_mesh_shape(4, (2, 2, 2)) == (1, 2, 2)   # lost a worker
+      surviving_mesh_shape(4, (2, 2))    == (2, 2)
+      surviving_mesh_shape(2, (2, 2))    == (1, 2)
+      surviving_mesh_shape(3, (2, 2, 2)) == (3, 1, 1)   # odd survivor
+    """
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"need at least one surviving device, got {n}")
+    want = tuple(int(v) for v in want)
+    if len(want) == 2:
+        d0, m0, p0 = want[0], want[1], 1
+    elif len(want) == 3:
+        d0, m0, p0 = want
+    else:
+        raise ValueError(
+            f"want must be (d, m) or (d, m, p), got {want!r}")
+    m = next(k for k in range(min(m0, n), 0, -1)
+             if m0 % k == 0 and n % k == 0)
+    rem = n // m
+    p = next(k for k in range(min(p0, rem), 0, -1)
+             if p0 % k == 0 and rem % k == 0)
+    d = rem // p
+    return (d, m) if len(want) == 2 else (d, m, p)
 
 
 def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]) -> Mesh:

@@ -1667,6 +1667,48 @@ class ParallelTrainer:
         self.model.iteration_count = self.iteration_count
         return self.model
 
+    def elastic_state(self):
+        """The logical, mesh-shape-INDEPENDENT training state (ISSUE 19):
+        the model-level {params, state, updater_state} trees (per-layer
+        tuples — pp strategies unstack their stage form) plus the scalar
+        metadata a restore needs to continue bit-exactly: iteration
+        count and the per-batch RNG chain key. The RNG chain advances
+        once per optimizer step (`jax.random.split` in `_fit_batch`)
+        regardless of mesh factorization, so restoring (trees, meta)
+        onto ANY (d, m, p) reshape continues the identical sequence.
+        Leaves may still be device arrays (possibly non-addressable in a
+        multi-process world); the coordinated store host-fetches them."""
+        model = self.publish_view()
+        tree = {"params": model.params, "state": model.state,
+                "updater_state": model.updater_state}
+        meta = {"iteration_count": int(self.iteration_count),
+                "epoch_count": int(getattr(model, "epoch_count", 0)),
+                "strategy": self.strategy,
+                "mesh_axes": {k: int(v)
+                              for k, v in dict(self.mesh.shape).items()},
+                "trainer_rng": np.asarray(self._rng).tolist()}
+        return tree, meta
+
+    def load_elastic_state(self, tree, meta):
+        """Re-land a logical state captured by `elastic_state` (possibly
+        on a different mesh shape/strategy) onto THIS trainer's mesh:
+        install the model-level trees, then `_prepare()` re-places them
+        per this trainer's strategy — the same re-placement path the
+        sharded restore uses — and reinstate the iteration count and
+        RNG chain the re-prepare reset."""
+        m = self.model
+        m.params = tree["params"]
+        m.state = tree["state"]
+        m.updater_state = tree["updater_state"]
+        m.iteration_count = int(meta.get("iteration_count", 0))
+        m.epoch_count = int(meta.get("epoch_count", 0))
+        self._prepare()
+        self.iteration_count = m.iteration_count
+        rng = meta.get("trainer_rng")
+        if rng is not None:
+            self._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+        return self
+
     def _sync_back(self):
         """Write averaged/replicated params back into the wrapped model."""
         if self._pp_plan is not None:

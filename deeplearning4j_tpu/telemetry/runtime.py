@@ -181,6 +181,24 @@ class TelemetrySession:
                 out[key] = int(sum(c.values().values()))
         return out
 
+    def elastic_summary(self) -> Dict:
+        """Elastic-training metrics (parallel/elastic.py): worker losses,
+        rejoins, mesh resizes and SIGTERM drains seen by the supervision
+        loop, plus coordinated-snapshot count + wall seconds. Empty dict
+        when no elastic loop ran under this session."""
+        out: Dict = {}
+        for event in ("worker_losses", "rejoins", "resizes", "drains"):
+            c = self.registry.get(f"dl4j_elastic_{event}_total")
+            if c is not None and c.values():
+                n = int(sum(c.values().values()))
+                if n:
+                    out[event] = n
+        t = self.registry.get("dl4j_elastic_snapshot_seconds")
+        if t is not None and t.count():
+            out["snapshots"] = t.count()
+            out["snapshot_s"] = round(t.sum(), 4)
+        return out
+
     def summary(self) -> Dict:
         """The compact dict bench.py embeds as extras.telemetry."""
         rep = self.compiles.report()
@@ -204,6 +222,9 @@ class TelemetrySession:
         fault = self.fault_summary()
         if fault:
             out["fault"] = fault
+        elastic = self.elastic_summary()
+        if elastic:
+            out["elastic"] = elastic
         return out
 
 

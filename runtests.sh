@@ -78,6 +78,17 @@
 #                            enabled-vs-disabled obs-overhead bench rep
 #                            (serving + LeNet fit arms; the >=0.95
 #                            paired-ratio gate)
+#   ./runtests.sh elastic    elastic-training smoke (ISSUE 19): the
+#                            coordinated two-phase-commit suite (every
+#                            commit boundary crash-injected, torn
+#                            COMMIT invisibility), the mesh-reshape
+#                            restore contract (zero1_tp_pp (2,2,2) ->
+#                            (1,2,4)/(1,1,8)/(4,2,1) bit-exact incl.
+#                            sharded optimizer moments), ElasticTrainer
+#                            loss/rejoin/drain loops, then the REAL
+#                            2-process kill/rejoin drills (slow marker;
+#                            capability-gated — they skip where the jax
+#                            CPU backend lacks multiprocess collectives)
 #   ./runtests.sh lint       graftlint, both tiers: the AST pass
 #                            (jit/tracer hygiene, recompile hazards,
 #                            donation safety, concurrency lint) AND the
@@ -156,6 +167,13 @@ if [[ "${1:-}" == "flash" ]]; then
         XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         python -m deeplearning4j_tpu.parallel.scaling_bench --devices 8 \
         --mode flash --steps 1 --reps 2
+fi
+if [[ "${1:-}" == "elastic" ]]; then
+    echo "=== elastic training smoke (2PC, reshape restore, supervision) ==="
+    python -m pytest tests/test_elastic.py -q
+    echo "=== real 2-process kill/rejoin drills (capability-gated) ==="
+    exec python -m pytest tests/test_multiprocess_distributed.py -q \
+        -k elastic
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

@@ -2,6 +2,8 @@
 
 Usage: python _dist_child.py <coordinator> <num_procs> <process_id> <outdir>
        python _dist_child.py --probe <coordinator> <num_procs> <process_id>
+       python _dist_child.py --elastic <coordinator> <num_procs> <process_id>
+                             <rundir> <n_steps> <gen>
 
 Each process owns 4 virtual CPU devices (XLA_FLAGS set by the parent);
 together they form one 8-device global mesh. Trains the same model on the
@@ -14,7 +16,17 @@ globally-reduced value. When the installed jax CPU backend cannot run
 multiprocess collectives, this exits non-zero (or hangs into the parent's
 timeout) — the parent then SKIPS the full suite with an environment
 reason instead of reporting the backend limitation as a red test.
-"""
+
+`--elastic` is one GENERATION of the ISSUE-19 kill/rejoin drills: arm
+fault injectors from DL4J_* env vars (`install_faults_from_env`), run the
+ElasticTrainer supervision loop for `n_steps` over the ZeRO-1 global mesh
+under `sanitize(collective_hash=True)`, and record the exit status, the
+per-step collective digest stream, and (when the loop survived) the final
+replicated params. The parent chains generations — kill one child
+mid-step / mid-commit / mid-drain, relaunch smaller, rejoin bigger — and
+asserts the committed-snapshot/resume contract across the whole chain."""
+import json
+import os
 import sys
 
 import numpy as np
@@ -42,9 +54,90 @@ def probe(coord, n_procs, pid):
     print(f"probe proc {pid} ok total={float(total)}")
 
 
+def elastic_factory():
+    """The drill model: fixed seed, rebuilt identically by every
+    generation (ElasticTrainer restores the trained state into it)."""
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer)
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def elastic_batches(n=10, b=16):
+    """The drill data schedule, keyed on the GLOBAL step ordinal — the
+    deterministic-reassignment half of the bit-exact resume contract
+    (every generation, at any world size, computes the same batch for
+    step k)."""
+    from deeplearning4j_tpu import DataSet
+
+    r = np.random.default_rng(0)
+    return [DataSet(r.normal(size=(b, 8)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[r.integers(0, 4, b)])
+            for _ in range(n)]
+
+
+def elastic(coord, n_procs, pid, rundir, n_steps, gen):
+    """One drill generation (see module docstring)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.analysis import sanitize
+    from deeplearning4j_tpu.analysis.sanitizer import (
+        collective_hashes_agree, current_collective_hasher)
+    from deeplearning4j_tpu.fault.injection import install_faults_from_env
+    from deeplearning4j_tpu.parallel import ShardingStrategy
+    from deeplearning4j_tpu.parallel.distributed import initialize
+    from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+
+    armed = install_faults_from_env()
+    if armed:
+        print(f"gen{gen} proc {pid} armed: {armed}")
+    if n_procs > 1:
+        assert initialize(coordinator_address=coord,
+                          num_processes=n_procs, process_id=pid)
+        assert jax.process_count() == n_procs
+
+    batches = elastic_batches()
+    et = ElasticTrainer(
+        elastic_factory, f"{rundir}/elastic",
+        mesh_shape=(len(jax.devices()), 1),
+        strategy=ShardingStrategy.ZERO1,
+        n_workers=n_procs, worker_id=pid, emulated=False,
+        snapshot_every=2, lease_ttl_s=3.0, commit_timeout_s=8.0)
+    with sanitize(collective_hash=True) as rep:
+        hasher = current_collective_hasher()
+        status = et.fit(lambda s: batches[s % len(batches)], n_steps)
+        # agreement check is itself a collective: only when the parent
+        # guarantees every process survives this generation
+        agree = None
+        if status in ("completed", "drained") and os.environ.get(
+                "DL4J_DRILL_CHECK_HASHES"):
+            agree = bool(collective_hashes_agree(hasher))
+    with open(f"{rundir}/status_p{pid}_gen{gen}.json", "w") as f:
+        json.dump({"status": status, "agree": agree,
+                   "iteration": int(et.trainer.iteration_count),
+                   "digests": rep.collective_step_digests}, f)
+    if status in ("completed", "drained"):
+        flat = np.asarray(et.trainer.publish_view().params_flat())
+        np.save(f"{rundir}/params_p{pid}_gen{gen}.npy", flat)
+    print(f"gen{gen} proc {pid} status={status} "
+          f"iter={et.trainer.iteration_count}")
+
+
 def main():
     if sys.argv[1] == "--probe":
         probe(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+        return
+    if sys.argv[1] == "--elastic":
+        elastic(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                sys.argv[5], int(sys.argv[6]), int(sys.argv[7]))
         return
     coord, n_procs, pid, outdir = (sys.argv[1], int(sys.argv[2]),
                                    int(sys.argv[3]), sys.argv[4])

@@ -1313,3 +1313,35 @@ def test_collective_hash_hook_observes_zero_training(request):
     tr2.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=1,
             superstep=2)
     assert h.step_digests == per_batch * 2, h.step_digests
+
+
+def test_ir_elastic_restore_clean_and_rostered():
+    """The elastic-restore re-placement probe (ISSUE 19): landing
+    replicated host trees onto the ZeRO-1 x TP shards is pure slicing —
+    zero collective bytes on every axis (the declared budgets are the
+    1KiB slack floor) — and the entry rides the self-host roster."""
+    ir, probes = _ir(), _probes()
+    entries = probes.elastic_entries()
+    assert {e.name for e in entries} == {"parallel/elastic_restore_2x4"}
+    for e in entries:
+        found = ir.analyze_entry(e)
+        assert not found, [f.render() for f in found]
+        assert e.declared_bytes_by_axis == {"data": 0, "model": 0,
+                                            "other": 0}
+    assert any(e.name.startswith("parallel/elastic_restore")
+               for e in probes.build_entries())
+
+
+def test_ir_elastic_restore_gather_mutation_caught():
+    """Seeded mutation (ISSUE 19 acceptance): invert the restore —
+    sharded inputs, replicated out_shardings — and the identity step
+    compiles to all-gathers (a resize that re-materializes every shard
+    on every device); the per-axis byte budgets fire."""
+    ir, probes = _ir(), _probes()
+    entry = probes.elastic_restore_entry(mutate="gather_replicated")
+    found = ir.analyze_entry(entry)
+    hits = [f for f in found if f.rule == "ir-implicit-reshard"
+            and ":bytes:" in f.snippet]
+    assert hits, [f.render() for f in found]
+    with pytest.raises(ValueError, match="unknown mutation"):
+        probes.elastic_restore_entry(mutate="bogus")

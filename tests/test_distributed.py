@@ -84,3 +84,75 @@ def test_local_batch_slice_rejects_ragged(monkeypatch):
     monkeypatch.setattr(dist.jax, "process_count", lambda: 4)
     with pytest.raises(ValueError, match="not divisible"):
         dist.local_batch_slice(30)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 19: elastic re-rendezvous — initialize() retries transient
+# coordinator failures with bounded exponential backoff, then fails with
+# an error that NAMES the coordinator address and the usual causes.
+# ----------------------------------------------------------------------
+
+def test_initialize_retries_transient_then_succeeds(monkeypatch):
+    attempts = []
+    delays = []
+
+    def flaky_init(coordinator_address=None, num_processes=None,
+                   process_id=None):
+        attempts.append(coordinator_address)
+        if len(attempts) < 3:
+            raise RuntimeError("DEADLINE_EXCEEDED: coordinator not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setattr(dist, "_sleep", delays.append)
+    from deeplearning4j_tpu import telemetry
+    with telemetry.enabled() as sess:
+        assert dist.initialize("10.0.0.1:1234", num_processes=2,
+                               process_id=1) is True
+        fault = sess.summary()["fault"]
+    assert attempts == ["10.0.0.1:1234"] * 3
+    assert delays == [0.5, 1.0]            # base * 2^(attempt-1)
+    assert fault["retries"] == 2
+
+
+def test_initialize_backoff_is_capped(monkeypatch):
+    delays = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(ConnectionError("refused")))
+    monkeypatch.setattr(dist, "_sleep", delays.append)
+    with pytest.raises(RuntimeError):
+        dist.initialize("h:1", max_retries=6, backoff_base_s=1.0,
+                        backoff_cap_s=4.0)
+    assert delays == [1.0, 2.0, 4.0, 4.0, 4.0, 4.0]
+
+
+def test_initialize_exhausted_error_names_coordinator(monkeypatch):
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("UNAVAILABLE")))
+    monkeypatch.setattr(dist, "_sleep", lambda s: None)
+    with pytest.raises(RuntimeError) as ei:
+        dist.initialize("badhost:4321", num_processes=4, max_retries=2)
+    msg = str(ei.value)
+    assert "badhost:4321" in msg
+    assert "3 attempt(s)" in msg
+    assert "num_processes (4)" in msg
+    assert "coordinator process (process_id=0)" in msg
+    assert isinstance(ei.value.__cause__, RuntimeError)   # chained
+
+
+def test_initialize_nonretryable_raises_immediately(monkeypatch):
+    """A config error (not a connection race) must not burn the retry
+    budget: only transient rendezvous exception types are retried."""
+    calls = []
+
+    def bad_config(**kw):
+        calls.append(1)
+        raise ValueError("process_id out of range")
+
+    monkeypatch.setattr(jax.distributed, "initialize", bad_config)
+    monkeypatch.setattr(dist, "_sleep",
+                        lambda s: pytest.fail("must not sleep"))
+    with pytest.raises(ValueError, match="out of range"):
+        dist.initialize("h:1")
+    assert calls == [1]

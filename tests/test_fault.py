@@ -642,3 +642,75 @@ def test_checkpoint_timers_land_in_fault_summary(tmp_path):
     assert fs["checkpoint_saves"]["zip"] >= 1
     assert fs["checkpoint_restores"]["zip"] >= 1
     assert fs["checkpoint_save_s"]["zip"] > 0
+
+
+# ======================================================================
+# ISSUE 19: process-level injectors for the elastic kill/rejoin drills
+# ======================================================================
+
+from deeplearning4j_tpu.fault import (clear_crash_hooks, hang_at_step,
+                                      install_faults_from_env, kill_at_step,
+                                      sigterm_at_step)
+from deeplearning4j_tpu.fault import injection as _inj
+
+
+@pytest.fixture(autouse=False)
+def _hooks():
+    yield
+    clear_crash_hooks()
+
+
+def test_kill_at_step_fires_on_exact_step(monkeypatch, _hooks):
+    exits = []
+    monkeypatch.setattr(_inj.os, "_exit", exits.append)
+    kill_at_step(2)
+    for step in range(4):
+        _inj.fire_crash_point(_inj.STEP_POINT, step=step, worker=0)
+    # fired exactly once, at step 2, with the 128+SIGKILL code harnesses
+    # use to tell an injected kill from an ordinary crash
+    assert exits == [137]
+
+
+def test_hang_at_step_stalls_without_exiting(monkeypatch, _hooks):
+    naps = []
+    monkeypatch.setattr(_inj.time, "sleep", naps.append)
+    hang_at_step(1, hang_s=7.5)
+    _inj.fire_crash_point(_inj.STEP_POINT, step=0)
+    _inj.fire_crash_point(_inj.STEP_POINT, step=1)
+    assert naps == [7.5]
+
+
+def test_sigterm_at_step_delivers_to_self(monkeypatch, _hooks):
+    sent = []
+    monkeypatch.setattr(_inj.os, "kill",
+                        lambda pid, sig: sent.append((pid, sig)))
+    sigterm_at_step(3)
+    _inj.fire_crash_point(_inj.STEP_POINT, step=3)
+    assert sent == [(os.getpid(), signal.SIGTERM)]
+
+
+def test_install_faults_from_env_arms_and_reports(_hooks):
+    armed = install_faults_from_env({
+        "DL4J_SIGTERM_AT_STEP": "5",
+        "DL4J_CRASH_AT_WRITE": "elastic/shards_written:2",
+        "DL4J_EXIT_AT_WRITE": "elastic/commit_marker",
+    })
+    assert armed == ["sigterm_at_step(5)",
+                     "crash_at_write(elastic/shards_written)",
+                     "exit_at_write(elastic/commit_marker)"]
+    assert install_faults_from_env({}) == []
+    # the armed write-boundary injector honors its nth: first firing is
+    # free, the second raises
+    _inj.fire_crash_point("elastic/shards_written", worker=0)
+    with pytest.raises(SimulatedCrash):
+        _inj.fire_crash_point("elastic/shards_written", worker=0)
+
+
+def test_exit_at_write_hard_exits_at_nth(monkeypatch, _hooks):
+    exits = []
+    monkeypatch.setattr(_inj.os, "_exit", exits.append)
+    install_faults_from_env({"DL4J_EXIT_AT_WRITE": "elastic/commit_marker:2"})
+    _inj.fire_crash_point("elastic/commit_marker", path="x")
+    assert exits == []
+    _inj.fire_crash_point("elastic/commit_marker", path="x")
+    assert exits == [137]

@@ -6,6 +6,7 @@ on the same global batch — the actual process-boundary analog of the
 reference's `TestCompareParameterAveragingSparkVsSingleMachine.java:44`
 (which crossed a real executor boundary in local-mode Spark).
 """
+import json
 import os
 import socket
 import subprocess
@@ -199,3 +200,252 @@ def test_two_process_training_matches_single_process(tmp_path):
         ev1 = json.load(f)
     assert ev0 and ev1
     assert abs(ev0[0]["epoch_ms"] - ev1[0]["epoch_ms"]) < 60_000
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: elastic kill/rejoin drills across REAL process boundaries.
+# Each drill chains GENERATIONS of tests/_dist_child.py --elastic runs:
+# kill a child mid-step / mid-commit / mid-drain via env-armed injectors,
+# relaunch a smaller world, rejoin the full world, and assert the
+# two-phase-commit contract (a torn snapshot is never served) plus the
+# deterministic-resume contract across the whole chain.
+# ---------------------------------------------------------------------------
+def _run_elastic_gen(rundir, gen, n_procs, n_steps, fault_env=None,
+                     check_hashes=False, timeout=300,
+                     expect_rc=None):
+    """Launch one drill generation; returns {pid: (rc, stdout)}."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    child = os.path.join(REPO, "tests", "_dist_child.py")
+    procs = []
+    for pid in range(n_procs):
+        env = _child_env()
+        if check_hashes:
+            env["DL4J_DRILL_CHECK_HASHES"] = "1"
+        env.update((fault_env or {}).get(pid, {}))
+        procs.append(subprocess.Popen(
+            [sys.executable, child, "--elastic", coord, str(n_procs),
+             str(pid), str(rundir), str(n_steps), str(gen)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    out = {}
+    for pid, p in enumerate(procs):
+        try:
+            o, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+            o += "\n[generation timed out]"
+        out[pid] = (p.returncode, o)
+    if expect_rc is not None:
+        for pid, rc in expect_rc.items():
+            assert out[pid][0] == rc, (
+                f"gen{gen} proc {pid}: rc={out[pid][0]} want {rc}\n"
+                f"{out[pid][1][-3000:]}")
+    return out
+
+
+def _gen_status(rundir, pid, gen):
+    with open(os.path.join(str(rundir),
+                           f"status_p{pid}_gen{gen}.json")) as f:
+        return json.load(f)
+
+
+def _committed_steps(rundir):
+    from deeplearning4j_tpu.fault.atomic import read_commit_marker
+    root = os.path.join(str(rundir), "elastic", "steps")
+    if not os.path.isdir(root):
+        return {}
+    out = {}
+    for name in sorted(os.listdir(root)):
+        if name.startswith("step_"):
+            out[int(name.split("_")[1])] = (
+                read_commit_marker(os.path.join(root, name)) is not None)
+    return out
+
+
+def _control_chain(segments, n_steps_total):
+    """Single-process control: train the drill model over the drill batch
+    schedule, live-switching the mesh at the given step edges —
+    (upto_step, n_devices) per segment — via elastic_state handoff."""
+    import jax
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _dist_child as dc
+    from deeplearning4j_tpu.parallel import (ParallelTrainer,
+                                             ShardingStrategy, make_mesh)
+
+    batches = dc.elastic_batches()
+    tr = None
+    step = 0
+    for upto, n_dev in segments:
+        mesh = make_mesh({"data": n_dev}, devices=jax.devices()[:n_dev])
+        nxt = ParallelTrainer(dc.elastic_factory(), mesh=mesh,
+                              strategy=ShardingStrategy.ZERO1)
+        if tr is not None:
+            tree, meta = tr.elastic_state()
+            nxt.load_elastic_state(tree, meta)
+        tr = nxt
+        while step < min(upto, n_steps_total):
+            tr.fit(batches[step % len(batches)])
+            step += 1
+    return np.asarray(tr.publish_view().params_flat())
+
+
+@pytest.mark.slow
+def test_elastic_kill_midstep_resize_rejoin_drill(tmp_path):
+    """Kill a worker mid-step (os._exit at the elastic/step point), let
+    the survivor exit cleanly via the step barrier, resume on a SMALLER
+    single-process world, then rejoin the full 2-process world — params
+    identical across processes, collective digest streams identical, and
+    the chain tracks the single-process live-switch control."""
+    _require_multiprocess_collectives()
+    # gen1: child 1 hard-killed at optimizer step 3 (exit code 137);
+    # child 0 must detect the silent peer and exit "worker_lost"
+    out = _run_elastic_gen(tmp_path, 1, 2, 8,
+                           fault_env={1: {"DL4J_KILL_AT_STEP": "3"}},
+                           expect_rc={0: 0, 1: 137})
+    st0 = _gen_status(tmp_path, 0, 1)
+    assert st0["status"] == "worker_lost", out[0][1][-2000:]
+    committed = _committed_steps(tmp_path)
+    assert committed.get(2) is True, committed   # edge snapshot landed
+    # gen2: ONE process (4 devices) resumes from step 2 and trains to 4
+    _run_elastic_gen(tmp_path, 2, 1, 4, expect_rc={0: 0})
+    st = _gen_status(tmp_path, 0, 2)
+    assert st["status"] == "completed" and st["iteration"] == 4
+    # gen3: the full 2-process world rejoins from step 4 and completes
+    _run_elastic_gen(tmp_path, 3, 2, 8, check_hashes=True,
+                     expect_rc={0: 0, 1: 0})
+    s0, s1 = _gen_status(tmp_path, 0, 3), _gen_status(tmp_path, 1, 3)
+    assert s0["status"] == s1["status"] == "completed"
+    assert s0["iteration"] == s1["iteration"] == 8
+    # identical collective digest streams — the divergence detector the
+    # drills run under (a stale plan after resize would differ HERE,
+    # in a comparable log line, instead of deadlocking a collective)
+    assert s0["digests"] and s0["digests"] == s1["digests"]
+    assert s0["agree"] is True and s1["agree"] is True
+    p0 = np.load(tmp_path / "params_p0_gen3.npy")
+    p1 = np.load(tmp_path / "params_p1_gen3.npy")
+    np.testing.assert_allclose(p0, p1, rtol=0, atol=0)
+    # the whole kill->shrink->rejoin chain tracks the single-process
+    # live-switch control (8 dev -> 4 dev at step 2 -> 8 dev at step 4)
+    ctrl = _control_chain([(2, 8), (4, 4), (8, 8)], 8)
+    np.testing.assert_allclose(p0, ctrl, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_elastic_kill_midcommit_never_serves_torn_snapshot(tmp_path):
+    """Kill at BOTH two-phase-commit boundaries: (a) a writer dies after
+    its shards are durable but before its DURABLE marker — the committer
+    times out and the snapshot stays uncommitted; (b) the COMMITTER dies
+    mid-COMMIT-rename — the torn marker is invisible (temp file only).
+    In both cases the next generation restores the previous committed
+    step, never the torn one."""
+    _require_multiprocess_collectives()
+    # (a) writer dies between durable shards and its DURABLE marker at
+    # the SECOND snapshot (step 4); the step-2 snapshot stays good
+    run_a = tmp_path / "a"
+    run_a.mkdir()
+    out = _run_elastic_gen(
+        run_a, 1, 2, 8,
+        fault_env={1: {"DL4J_EXIT_AT_WRITE": "elastic/shards_written:2"}},
+        expect_rc={0: 0, 1: 137})
+    st0 = _gen_status(run_a, 0, 1)
+    assert st0["status"] == "worker_lost", out[0][1][-2000:]
+    committed = _committed_steps(run_a)
+    assert committed.get(2) is True, committed
+    assert committed.get(4) is False, committed      # torn: never COMMITs
+    _run_elastic_gen(run_a, 2, 1, 6, expect_rc={0: 0})
+    st = _gen_status(run_a, 0, 2)
+    assert st["status"] == "completed" and st["iteration"] == 6
+    ctrl = _control_chain([(2, 8), (6, 4)], 6)
+    np.testing.assert_allclose(np.load(run_a / "params_p0_gen2.npy"),
+                               ctrl, rtol=2e-5, atol=1e-6)
+
+    # (b) the COMMITTER dies inside the COMMIT marker's atomic_replace
+    # (temp bytes written, never renamed): every shard is durable and
+    # DURABLE-marked, yet the snapshot must stay invisible
+    run_b = tmp_path / "b"
+    run_b.mkdir()
+    out = _run_elastic_gen(
+        run_b, 1, 2, 8,
+        fault_env={0: {"DL4J_EXIT_AT_WRITE": "elastic/commit_marker:2"}},
+        expect_rc={0: 137, 1: 0})
+    st1 = _gen_status(run_b, 1, 1)
+    assert st1["status"] == "worker_lost", out[1][1][-2000:]
+    committed = _committed_steps(run_b)
+    assert committed.get(2) is True, committed
+    assert committed.get(4) is False, committed
+    step4 = os.path.join(str(run_b), "elastic", "steps", "step_000000004")
+    names = os.listdir(step4)
+    assert "DURABLE_p0" in names and "DURABLE_p1" in names, names
+    assert "COMMIT" not in names, names               # only the .tmp ghost
+    _run_elastic_gen(run_b, 2, 1, 6, expect_rc={0: 0})
+    st = _gen_status(run_b, 0, 2)
+    assert st["status"] == "completed" and st["iteration"] == 6
+
+
+@pytest.mark.slow
+def test_elastic_sigterm_drain_and_kill_middrain_drill(tmp_path):
+    """SIGTERM-window draining across the process boundary: one worker
+    gets the preemption notice, BOTH land the same superstep edge, take
+    one coordinated snapshot there and exit "drained"; the next
+    generation resumes bit-exactly (vs an uninterrupted real 2-process
+    control). Then the hostile variant: a worker killed MID-drain (inside
+    the drain snapshot) downgrades the drain to worker_lost without ever
+    committing a torn snapshot."""
+    _require_multiprocess_collectives()
+    run = tmp_path / "drain"
+    run.mkdir()
+    out = _run_elastic_gen(run, 1, 2, 6,
+                           fault_env={1: {"DL4J_SIGTERM_AT_STEP": "1"}},
+                           check_hashes=True, expect_rc={0: 0, 1: 0})
+    s0, s1 = _gen_status(run, 0, 1), _gen_status(run, 1, 1)
+    assert s0["status"] == s1["status"] == "drained", (out[0][1][-1500:],
+                                                      out[1][1][-1500:])
+    assert s0["iteration"] == s1["iteration"] == 2   # the common edge
+    assert s0["digests"] == s1["digests"]
+    committed = _committed_steps(run)
+    assert committed.get(2) is True, committed
+    np.testing.assert_allclose(np.load(run / "params_p0_gen1.npy"),
+                               np.load(run / "params_p1_gen1.npy"),
+                               rtol=0, atol=0)
+    # gen2: full world resumes the drained edge and completes
+    _run_elastic_gen(run, 2, 2, 6, check_hashes=True,
+                     expect_rc={0: 0, 1: 0})
+    s0, s1 = _gen_status(run, 0, 2), _gen_status(run, 1, 2)
+    assert s0["status"] == s1["status"] == "completed"
+    assert s0["agree"] is True and s1["agree"] is True
+    p0 = np.load(run / "params_p0_gen2.npy")
+    np.testing.assert_allclose(p0, np.load(run / "params_p1_gen2.npy"),
+                               rtol=0, atol=0)
+    # bit-exact resume in the REAL world: an uninterrupted 2-process run
+    # of the same 6 steps on the same mesh must match exactly
+    ctrl_run = tmp_path / "ctrl"
+    ctrl_run.mkdir()
+    _run_elastic_gen(ctrl_run, 1, 2, 6, expect_rc={0: 0, 1: 0})
+    np.testing.assert_allclose(p0,
+                               np.load(ctrl_run / "params_p0_gen1.npy"),
+                               rtol=0, atol=0)
+
+    # hostile variant: the drain snapshot itself is killed mid-write —
+    # the survivor times out into worker_lost and NOTHING commits
+    run2 = tmp_path / "middrain"
+    run2.mkdir()
+    out = _run_elastic_gen(
+        run2, 1, 2, 6,
+        fault_env={1: {"DL4J_SIGTERM_AT_STEP": "1",
+                       "DL4J_EXIT_AT_WRITE": "elastic/shards_written:1"}},
+        expect_rc={0: 0, 1: 137})
+    s0 = _gen_status(run2, 0, 1)
+    assert s0["status"] == "worker_lost", out[0][1][-2000:]
+    committed = _committed_steps(run2)
+    assert True not in committed.values(), committed
+    # the next (shrunken) generation starts from scratch — torn bytes on
+    # disk are indistinguishable from no snapshot at all
+    _run_elastic_gen(run2, 2, 1, 4, expect_rc={0: 0})
+    st = _gen_status(run2, 0, 2)
+    assert st["status"] == "completed" and st["iteration"] == 4
+    ctrl = _control_chain([(4, 4)], 4)
+    np.testing.assert_allclose(np.load(run2 / "params_p0_gen2.npy"),
+                               ctrl, rtol=2e-5, atol=1e-6)
