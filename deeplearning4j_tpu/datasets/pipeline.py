@@ -49,7 +49,7 @@ from .iterators import (AsyncDataSetIterator, DataSet, DataSetIterator,
 __all__ = ["PadToBatchIterator", "DevicePrefetchIterator",
            "MicrobatchSplitIterator", "pad_dataset", "pad_rows",
            "build_pipeline", "split_microbatches", "stage_window",
-           "batch_nbytes"]
+           "batch_nbytes", "split_xy"]
 
 
 # ---------------------------------------------------------------------------
@@ -109,6 +109,24 @@ def pad_rows(a, n_pad):
 
 
 _pad_rows = pad_rows
+
+
+def split_xy(record: np.ndarray, feature_width: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Split a tokenized topic record `[rows, feature_width + ny]` into
+    `(features, labels)` float32 arrays — the streaming plane publishes
+    each training window as one such concatenated array (the continual
+    trainer's default record decoder). A 1-D record is treated as a
+    single row."""
+    a = np.asarray(record, np.float32)
+    if a.ndim == 1:
+        a = a[None]
+    if a.ndim != 2 or a.shape[1] <= feature_width:
+        raise ValueError(
+            f"record shape {tuple(a.shape)} cannot split into "
+            f"features[:{feature_width}] + labels — expected "
+            f"[rows, > {feature_width}]")
+    return a[:, :feature_width], a[:, feature_width:]
 
 
 def _pad_time(a, t_pad, axis=1):

@@ -34,6 +34,18 @@ can be killed at exact steps / exact two-phase-commit boundaries:
 ``elastic/durable_marked`` (between the phases) and
 ``elastic/commit_marker`` (torn COMMIT marker — temp bytes written, never
 renamed).
+
+**Continual-plane points** (ISSUE 20): the `ContinualTrainer` loop fires
+one point at every durable boundary of a train-to-serve cycle, so the
+crash drill in tests/test_continual.py can kill the loop between ANY two
+effects and assert recovery serves exactly the pre-crash committed
+version: ``continual/stable_registered``, ``continual/window_consumed``,
+``continual/window_trained``, ``continual/candidate_saved``,
+``continual/window_record`` (window journaled — the train-once commit
+point), ``continual/offset_committed``, ``continual/gate_record``,
+``continual/canary_started``, ``continual/decision_record``
+(promoted/rolled_back journaled — THE decision commit point, before the
+registry flip) and ``continual/decision_applied``.
 """
 from __future__ import annotations
 

@@ -25,13 +25,14 @@ unbatched (surfaced as bench.py extras["Serving-latency"]).
 from .batcher import BatcherClosedError, DynamicBatcher
 from .bench import run_serving_bench
 from .quantize import QuantizedTree, cast_tree, quantize_tree
-from .registry import (DEFAULT_BUCKETS, ModelRegistry, PRECISIONS,
-                       ServableVersion, ServingError, UnknownModelError,
-                       load_source)
+from .registry import (AotCompileError, CanaryState, DEFAULT_BUCKETS,
+                       ModelRegistry, PRECISIONS, ServableVersion,
+                       ServingError, UnknownModelError, load_source)
 from .server import ClientError, InferenceServer
 
 __all__ = [
     "ModelRegistry", "ServableVersion", "ServingError", "UnknownModelError",
+    "AotCompileError", "CanaryState",
     "DEFAULT_BUCKETS", "PRECISIONS", "load_source",
     "DynamicBatcher", "BatcherClosedError",
     "InferenceServer", "ClientError",

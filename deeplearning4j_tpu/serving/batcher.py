@@ -147,7 +147,8 @@ class DynamicBatcher:
     def __init__(self, runner: Callable, bucket_for: Callable[[int], int],
                  max_batch: int, max_wait_us: int = 2000,
                  name: str = "model", metrics=None,
-                 buckets: Optional[Tuple[int, ...]] = None):
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 arm: str = "stable"):
         self._runner = runner
         self._bucket_for = bucket_for
         self.max_batch = int(max_batch)
@@ -155,6 +156,11 @@ class DynamicBatcher:
         self.buckets = tuple(sorted(buckets)) if buckets else None
         self._flush_ema = FlushEma()
         self.name = name
+        # which canary arm this batcher serves ("stable" outside a
+        # canary); per-arm batchers let the continual plane retire the
+        # candidate's queue without touching in-flight stable requests
+        self.arm = arm
+        self._stop_lock = threading.Lock()
         # enqueue is lock-free: deque.append is atomic under the GIL and
         # the worker is the only consumer, so clients pay one append + one
         # Event.set per request instead of a contended mutex round trip
@@ -228,12 +234,18 @@ class DynamicBatcher:
     def stop(self, drain: bool = True):
         """Stop the worker. With `drain` (default) queued requests are
         flushed first — shutdown never drops accepted work; without it
-        they fail with BatcherClosedError."""
-        if self._stopped:
-            return
-        if not drain:
-            self._fail_queued()
-        self._stopped = True
+        they fail with BatcherClosedError. Idempotent and safe to call
+        from multiple threads (the canary plane retires arm batchers from
+        HTTP handlers while server shutdown may stop them concurrently):
+        exactly one caller performs the transition, the rest return once
+        the flag is set (the transitioning caller handles the join +
+        final drain)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            if not drain:
+                self._fail_queued()
+            self._stopped = True
         self._wake.set()
         self._worker.join(timeout=10.0)
         self._fail_queued()   # anything the worker didn't get to

@@ -87,12 +87,18 @@ class GenerationScheduler:
                  kv_dtype: str = "fp32",
                  decode_buckets: Sequence[int] = (1, 2, 4, 8),
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 metrics=None, idle_wait_s: float = 0.02):
+                 metrics=None, idle_wait_s: float = 0.02,
+                 arm: str = "stable"):
         if mode not in ("continuous", "static"):
             raise GenerationError(f"mode must be continuous|static, "
                                   f"got {mode!r}")
         self.name = name
         self.mode = mode
+        # canary arm this scheduler serves: a "canary" scheduler
+        # resolves the candidate version each tick (falling back to
+        # stable after a rollback — the existing flush-on-version-change
+        # path then restarts its running sequences on the stable version)
+        self.arm = arm
         self.registry = registry
         self.engine = DecodeEngine(
             registry, name, block_len=block_len, num_blocks=num_blocks,
@@ -127,7 +133,10 @@ class GenerationScheduler:
                 "wall seconds per compiled generation step",
                 labels=("model", "phase"))
         self._worker = threading.Thread(
-            target=self._run, name=f"dl4j-decode-sched-{name}", daemon=True)
+            target=self._run,
+            name=(f"dl4j-decode-sched-{name}" if arm == "stable"
+                  else f"dl4j-decode-sched-{name}-{arm}"),
+            daemon=True)
         self._worker.start()
 
     # -- client side -----------------------------------------------------
@@ -342,6 +351,17 @@ class GenerationScheduler:
             if self._append_sample(seq, row):
                 self._running.remove(seq)
 
+    def _resolve_version(self):
+        """The version this scheduler's arm serves this tick. Canary
+        schedulers resolve through the registry's arm routing (which
+        falls back to stable once the canary is promoted or rolled
+        back); registries without the canary surface (ducks in tests)
+        resolve the plain current version."""
+        arm_version = getattr(self.registry, "arm_version", None)
+        if arm_version is not None:
+            return arm_version(self.name, self.arm)
+        return self.registry.get(self.name)
+
     def _run(self):
         while True:
             # idle wait happens on the Event, never under self._lock, so
@@ -357,7 +377,7 @@ class GenerationScheduler:
                 self._wake.wait(self._idle_wait_s)
                 self._wake.clear()
             try:
-                v = self.registry.get(self.name)
+                v = self._resolve_version()
                 if self._version is not v:
                     self._flush_running()
                     self._version = v

@@ -47,7 +47,8 @@ from ..datasets.pipeline import pad_rows
 from .quantize import QuantizedTree, cast_tree, quantize_tree
 
 __all__ = ["ModelRegistry", "ServableVersion", "UnknownModelError",
-           "ServingError", "DEFAULT_BUCKETS", "PRECISIONS", "load_source"]
+           "ServingError", "AotCompileError", "CanaryState",
+           "DEFAULT_BUCKETS", "PRECISIONS", "load_source"]
 
 DEFAULT_BUCKETS = (1, 8, 32)
 PRECISIONS = ("fp32", "bf16", "int8")
@@ -55,6 +56,23 @@ PRECISIONS = ("fp32", "bf16", "int8")
 
 class ServingError(RuntimeError):
     """Client-facing serving failure (bad shape, unknown precision, ...)."""
+
+
+class AotCompileError(ServingError):
+    """A candidate version failed its AOT lower+compile during
+    `swap()`/`start_canary()`. Structured: carries the model name, the
+    batch bucket that failed, and the underlying compiler exception. The
+    registry guarantees the failed build is fully discarded — the live
+    version keeps serving and the shared executable cache holds no entry
+    from the rejected candidate."""
+
+    def __init__(self, model: str, bucket, cause: BaseException):
+        self.model = model
+        self.bucket = bucket
+        self.cause = cause
+        super().__init__(
+            f"{model}: AOT compile failed for bucket {bucket}: "
+            f"{type(cause).__name__}: {cause}")
 
 
 class UnknownModelError(KeyError):
@@ -188,13 +206,73 @@ class ServableVersion:
         }
 
 
+class CanaryState:
+    """Live canary for one model: the candidate version, its routing
+    fraction, and per-arm observations (requests, errors, latency, SLO
+    breaches) that the continual plane's promotion policy reads.
+
+    Routing is DETERMINISTIC: a per-model admission counter sends request
+    `i` to the candidate iff ``i % 100 < round(fraction * 100)`` — the
+    same request sequence always splits the same way, so canary drills
+    are replayable. The internal lock is a leaf lock (nothing else is
+    ever acquired under it), touched only for a counter bump or a stats
+    write — nanoseconds on the request path."""
+
+    __slots__ = ("version", "fraction", "started_at", "_slice",
+                 "_counter", "_lock", "_arms")
+
+    def __init__(self, version: ServableVersion, fraction: float):
+        if not 0.0 < fraction < 1.0:
+            raise ServingError(
+                f"canary fraction must be in (0, 1), got {fraction}")
+        self.version = version
+        self.fraction = float(fraction)
+        self.started_at = time.time()
+        self._slice = max(1, round(self.fraction * 100))
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._arms = {arm: {"requests": 0, "errors": 0, "breaches": 0,
+                            "latency_sum": 0.0, "latency_max": 0.0}
+                      for arm in ("stable", "canary")}
+
+    def route_arm(self) -> str:
+        with self._lock:
+            i = self._counter
+            self._counter += 1
+        return "canary" if i % 100 < self._slice else "stable"
+
+    def observe(self, arm: str, latency_s: Optional[float] = None,
+                error: bool = False, breach: bool = False):
+        s = self._arms[arm]
+        with self._lock:
+            s["requests"] += 1
+            if error:
+                s["errors"] += 1
+            if breach:
+                s["breaches"] += 1
+            if latency_s is not None:
+                s["latency_sum"] += latency_s
+                if latency_s > s["latency_max"]:
+                    s["latency_max"] = latency_s
+
+    def stats(self) -> Dict:
+        with self._lock:
+            arms = {a: dict(s) for a, s in self._arms.items()}
+        for s in arms.values():
+            n = max(1, s["requests"] - s["errors"])
+            s["latency_mean"] = s["latency_sum"] / n
+        return {"version": self.version.version, "fraction": self.fraction,
+                "started_at": self.started_at, "arms": arms}
+
+
 class _Entry:
     """Per-model-name mutable registry slot: the current version pointer,
-    the executable cache (abstract-signature keyed, survives swaps), and a
-    swap lock serializing rebuilds of this one model."""
+    the executable cache (abstract-signature keyed, survives swaps), an
+    optional live canary, and a swap lock serializing rebuilds of this
+    one model."""
 
     __slots__ = ("current", "version_counter", "compiled", "swap_lock",
-                 "sig_history")
+                 "sig_history", "canary")
 
     def __init__(self):
         self.current: Optional[ServableVersion] = None
@@ -202,6 +280,7 @@ class _Entry:
         self.compiled: Dict[tuple, object] = {}
         self.sig_history: list = []   # newest-first abstract sigs, max 2
         self.swap_lock = threading.Lock()
+        self.canary: Optional[CanaryState] = None
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +328,10 @@ class ModelRegistry:
             "dl4j_serving_compile_seconds",
             "wall seconds per serving AOT lower+compile",
             labels=("model",))
+        self._canary_req = metrics.counter(
+            "dl4j_continual_canary_requests_total",
+            "requests observed per arm while a canary is active",
+            labels=("model", "arm"))
 
     # -- registration / swap --------------------------------------------
     def register(self, name: str, source, *, precision: Optional[str] = None,
@@ -262,6 +345,11 @@ class ModelRegistry:
         with self._lock:
             entry = self._entries.setdefault(name, _Entry())
         with entry.swap_lock:
+            if entry.canary is not None:
+                raise ServingError(
+                    f"{name}: a canary (candidate v"
+                    f"{entry.canary.version.version}) is active — promote "
+                    "or roll it back before swapping a new version in")
             return self._register_locked(entry, name, source,
                                          precision=precision,
                                          buckets=buckets,
@@ -314,8 +402,19 @@ class ModelRegistry:
                 version = entry.current
                 if version is None:
                     continue
+                seen = set()
                 for bucket in version.buckets:
                     out.append((name, bucket, version.runners[bucket]))
+                    seen.add(id(version.runners[bucket]))
+                # a live canary serves traffic too — audit its
+                # executables as well (a same-architecture candidate
+                # shares the stable executables, so dedupe by identity)
+                if entry.canary is not None:
+                    cand = entry.canary.version
+                    for bucket in cand.buckets:
+                        r = cand.runners[bucket]
+                        if id(r) not in seen:
+                            out.append((name, bucket, r))
         return out
 
     # -- lookup ---------------------------------------------------------
@@ -342,12 +441,15 @@ class ModelRegistry:
         return self._current(name) is not None
 
     # -- inference (direct, unbatched path) -----------------------------
-    def predict(self, name: str, features) -> Tuple[np.ndarray, int]:
+    def predict(self, name: str, features, arm: str = "stable"
+                ) -> Tuple[np.ndarray, int]:
         """Direct single-request forward: chunk by the largest bucket, pad
         each chunk up to its bucket with zero rows (the PadToBatch shape
         discipline), run the compiled executable, strip padding. Returns
-        `(outputs, version)`. The whole request runs on ONE version."""
-        v = self.get(name)
+        `(outputs, version)`. The whole request runs on ONE version —
+        the canary candidate's when `arm="canary"` and a canary is active
+        (stable otherwise)."""
+        v = self.get(name) if arm == "stable" else self.arm_version(name, arm)
         x = _validate_features(v, features)
         top = v.buckets[-1]
         outs = []
@@ -359,6 +461,116 @@ class ModelRegistry:
             outs.append(out[:chunk.shape[0]])
         return (outs[0] if len(outs) == 1 else np.concatenate(outs)), \
             v.version
+
+    # -- canary routing (continual train-to-serve plane) ----------------
+    def start_canary(self, name: str, source, *, fraction: float = 0.1,
+                     precision: Optional[str] = None,
+                     buckets: Optional[Sequence[int]] = None,
+                     input_shape: Optional[Sequence[int]] = None
+                     ) -> ServableVersion:
+        """Build and AOT-compile a CANDIDATE version of `name` and expose
+        it to a deterministic `fraction` slice of traffic WITHOUT touching
+        the current (stable) version. The candidate gets the next
+        monotonic version number immediately — version numbers are never
+        reused, even if this canary later rolls back. A same-architecture
+        candidate reuses the stable version's executables through the
+        shared cache: zero new XLA compiles. Raises `AotCompileError`
+        (live version + cache untouched) if the candidate fails to
+        compile, and `ServingError` if a canary is already active."""
+        if not 0.0 < float(fraction) < 1.0:
+            raise ServingError(
+                f"canary fraction must be in (0, 1), got {fraction}")
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise UnknownModelError(name)
+        with entry.swap_lock:
+            if entry.current is None:
+                raise UnknownModelError(name)
+            if entry.canary is not None:
+                raise ServingError(
+                    f"{name}: a canary (candidate v"
+                    f"{entry.canary.version.version}) is already active")
+            version = self._build_version(entry, name, source,
+                                          precision=precision,
+                                          buckets=buckets,
+                                          input_shape=input_shape)
+            with self._lock:
+                entry.version_counter += 1
+                version.version = entry.version_counter
+                entry.canary = CanaryState(version, float(fraction))
+        return version
+
+    def canary_state(self, name: str) -> Optional[CanaryState]:
+        with self._lock:
+            entry = self._entries.get(name)
+            return entry.canary if entry is not None else None
+
+    def route_arm(self, name: str) -> str:
+        """Which arm serves the next request: "canary" for the
+        deterministic fraction slice while a canary is active, else
+        "stable"."""
+        cs = self.canary_state(name)
+        return cs.route_arm() if cs is not None else "stable"
+
+    def arm_version(self, name: str, arm: str = "stable") -> ServableVersion:
+        """The version serving `arm`. Falls back to the stable version
+        when no canary is active — a request routed to "canary" just
+        before a rollback still gets a servable version, never an
+        error."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None or entry.current is None:
+                raise UnknownModelError(name)
+            if arm == "canary" and entry.canary is not None:
+                return entry.canary.version
+            return entry.current
+
+    def observe_canary(self, name: str, arm: str,
+                       latency_s: Optional[float] = None,
+                       error: bool = False, breach: bool = False):
+        """Feed one request observation into the live canary's per-arm
+        stats (and the `dl4j_continual_canary_requests_total` counter).
+        No-op when no canary is active."""
+        cs = self.canary_state(name)
+        if cs is None:
+            return
+        cs.observe(arm, latency_s=latency_s, error=error, breach=breach)
+        self._canary_req.inc(model=name, arm=arm)
+
+    def promote_canary(self, name: str) -> ServableVersion:
+        """Atomically make the canary candidate the stable version (the
+        same single-pointer flip as `swap()`; in-flight requests finish on
+        whichever version they already hold)."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise UnknownModelError(name)
+        with entry.swap_lock:
+            cs = entry.canary
+            if cs is None:
+                raise ServingError(f"{name}: no canary is active")
+            with self._lock:
+                entry.current = cs.version
+                entry.canary = None
+        self._swaps.inc(model=name)
+        self._version_g.set(cs.version.version, model=name)
+        return cs.version
+
+    def rollback_canary(self, name: str) -> ServableVersion:
+        """Drop the canary candidate; the stable version (bit-identical,
+        never touched by the canary) keeps serving all traffic. Returns
+        the stable version."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise UnknownModelError(name)
+        with entry.swap_lock:
+            if entry.canary is None:
+                raise ServingError(f"{name}: no canary is active")
+            with self._lock:
+                entry.canary = None
+            return entry.current
 
     # -- version building -----------------------------------------------
     def _build_version(self, entry: _Entry, name: str, source, *,
@@ -382,6 +594,11 @@ class ModelRegistry:
         fn = jax.jit(_make_forward(model, snapshot))
         sig = _abstract_sig(snapshot, state, precision)
         runners = {}
+        # stage fresh compiles locally and merge only after EVERY bucket
+        # compiled: a candidate whose compile fails mid-build must leave
+        # the shared executable cache (and the live version still serving
+        # from it) bit-for-bit untouched
+        staged: Dict[tuple, Tuple[object, float]] = {}
         for b in buckets:
             # namespaced key: the stateless plane and the decode plane
             # (serving/decode, keys ("decode", sig, phase, ...)) share one
@@ -393,11 +610,18 @@ class ModelRegistry:
             if compiled is None:
                 x_spec = jax.ShapeDtypeStruct((b,) + shape, jnp.float32)
                 t0 = time.perf_counter()
-                compiled = fn.lower(snapshot.data, state, x_spec).compile()
-                wall = time.perf_counter() - t0
-                entry.compiled[key] = compiled
-                self._record_compile(name, b, wall)
+                try:
+                    compiled = fn.lower(snapshot.data, state,
+                                        x_spec).compile()
+                except ServingError:
+                    raise
+                except Exception as e:
+                    raise AotCompileError(name, b, e) from e
+                staged[key] = (compiled, time.perf_counter() - t0)
             runners[b] = compiled
+        for key, (compiled, wall) in staged.items():
+            entry.compiled[key] = compiled
+            self._record_compile(name, key[2], wall)
         # bound the executable cache: keep the current and the previous
         # architecture's executables (A/B rollback stays compile-free),
         # drop older — a long-lived server cycling checkpoints must not

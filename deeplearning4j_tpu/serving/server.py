@@ -17,6 +17,21 @@ Endpoints
                                        "seed"?}
                                       -> {"tokens": [ids],
                                           "finish_reason": ..., ...}
+    GET  /v1/models/<name>/canary     -> {"active": bool, "version"?,
+                                          "fraction"?, "arms"?: {...}}
+    POST /v1/models/<name>/canary     {"action": "start"|"promote"|
+                                       "rollback", "source"? (start),
+                                       "fraction"?, "precision"?,
+                                       "buckets"?, "input_shape"?}
+                                      -> candidate/stable info
+
+Canary routing: while a canary is active (started by the continual
+plane's ContinualTrainer or via POST /canary), a deterministic fraction
+of predict/generate traffic serves on the candidate version through its
+OWN batcher/scheduler (per-arm queues: retiring the candidate never
+touches in-flight stable requests), and every request's latency, error,
+and SLO-breach outcome is observed per arm into the registry's
+CanaryState — the signal that drives automatic promotion or rollback.
     GET  /healthz                     -> {"status": "ok", "models": {...}}
     GET  /metrics                     -> Prometheus text (0.0.4)
     GET  /debug/flightrecord          -> flight-recorder view: last guard
@@ -58,7 +73,8 @@ from .registry import (ModelRegistry, ServingError, UnknownModelError,
 
 __all__ = ["InferenceServer", "ClientError"]
 
-_MODEL_PATH = re.compile(r"^/v1/models/([^/]+)(?:/(predict|swap|generate))?$")
+_MODEL_PATH = re.compile(
+    r"^/v1/models/([^/]+)(?:/(predict|swap|generate|canary))?$")
 
 
 class ClientError(ValueError):
@@ -105,9 +121,13 @@ class InferenceServer:
         self.batching = bool(batching)
         self.max_wait_us = int(max_wait_us)
         self.max_batch = max_batch
-        self._batchers: Dict[str, DynamicBatcher] = {}
+        # both maps are keyed (model name, arm): per-arm queues mean a
+        # canary promote/rollback retires the candidate's batcher and
+        # scheduler without ever touching in-flight stable requests
+        self._batchers: Dict[Tuple[str, str], DynamicBatcher] = {}
         self._batchers_lock = threading.Lock()
-        self._schedulers: Dict[str, GenerationScheduler] = {}
+        self._schedulers: Dict[Tuple[str, str], GenerationScheduler] = {}
+        self._sched_opts: Dict[str, Dict] = {}
         self._stopping = False
         self._started_at = time.time()
         m = self.registry.metrics
@@ -125,8 +145,8 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- data plane (also driven directly by serving/bench.py) ----------
-    def _batcher(self, name: str) -> DynamicBatcher:
-        b = self._batchers.get(name)   # GIL-atomic fast path, no mutex
+    def _batcher(self, name: str, arm: str = "stable") -> DynamicBatcher:
+        b = self._batchers.get((name, arm))  # GIL-atomic fast path, no mutex
         if b is not None:
             return b
         with self._batchers_lock:
@@ -137,85 +157,129 @@ class InferenceServer:
                 # before taking this lock for the drain, so a creator
                 # either finishes first (and gets drained) or sees it
                 raise BatcherClosedError("server is stopping")
-            b = self._batchers.get(name)
+            b = self._batchers.get((name, arm))
             if b is None:
                 reg = self.registry
 
-                def runner(x_padded, bucket, _name=name):
-                    v = reg.get(_name)
+                def runner(x_padded, bucket, _name=name, _arm=arm):
+                    # per-flush arm resolution: a canary batcher serves
+                    # the candidate while one is active and falls back
+                    # to stable the moment it is promoted/rolled back
+                    v = reg.arm_version(_name, _arm)
                     if bucket in v.runners:
                         return v.run_padded(x_padded, bucket), v.version
                     # a swap changed the bucket set between enqueue and
                     # flush: serve via the direct path (pad rows ride
                     # along; the batcher scatters only the real rows)
-                    return reg.predict(_name, x_padded)
+                    return reg.predict(_name, x_padded, arm=_arm)
 
-                v = reg.get(name)
+                v = reg.arm_version(name, arm)
                 b = DynamicBatcher(
                     runner,
-                    bucket_for=lambda rows, _n=name:
-                        reg.get(_n).bucket_for(rows),
+                    bucket_for=lambda rows, _n=name, _a=arm:
+                        reg.arm_version(_n, _a).bucket_for(rows),
                     # clamped: a flush can never exceed the largest
                     # compiled bucket, and requests beyond it must route
                     # to the direct path (which chunks) instead
                     max_batch=min(self.max_batch or v.buckets[-1],
                                   v.buckets[-1]),
-                    max_wait_us=self.max_wait_us, name=name,
-                    metrics=reg.metrics, buckets=v.buckets)
-                self._batchers[name] = b
+                    max_wait_us=self.max_wait_us,
+                    name=name if arm == "stable" else f"{name}:{arm}",
+                    metrics=reg.metrics, buckets=v.buckets, arm=arm)
+                self._batchers[(name, arm)] = b
             return b
 
     # -- generation plane ------------------------------------------------
-    def enable_generation(self, name: str, **opts) -> GenerationScheduler:
+    def enable_generation(self, name: str, arm: str = "stable",
+                          **opts) -> GenerationScheduler:
         """Attach a GenerationScheduler (continuous batching + paged KV
         cache) to servable `name`. `opts` pass through to the scheduler
         (mode, block_len, num_blocks, kv_dtype, decode_buckets, ...).
-        Idempotent for a given name; called lazily with defaults by the
-        first /generate request if never called explicitly."""
+        Idempotent for a given (name, arm); called lazily with defaults
+        by the first /generate request if never called explicitly. The
+        stable arm's opts are remembered so a canary scheduler created
+        lazily for candidate traffic mirrors them."""
         with self._batchers_lock:
             if self._stopping:
                 raise BatcherClosedError("server is stopping")
-            sched = self._schedulers.get(name)
+            sched = self._schedulers.get((name, arm))
             if sched is None:
+                if arm == "stable":
+                    self._sched_opts[name] = dict(opts)
                 sched = GenerationScheduler(
                     self.registry, name, metrics=self.registry.metrics,
-                    **opts)
-                self._schedulers[name] = sched
+                    arm=arm, **opts)
+                self._schedulers[(name, arm)] = sched
             return sched
 
     def disable_generation(self, name: str):
-        """Drain and detach `name`'s scheduler (bench windows swap
-        continuous/static schedulers on one server this way)."""
+        """Drain and detach `name`'s schedulers, both arms (bench windows
+        swap continuous/static schedulers on one server this way)."""
         with self._batchers_lock:
-            sched = self._schedulers.pop(name, None)
-        if sched is not None:
-            sched.stop(drain=True)
+            scheds = [self._schedulers.pop((name, a), None)
+                      for a in ("stable", "canary")]
+            self._sched_opts.pop(name, None)
+        for sched in scheds:
+            if sched is not None:
+                sched.stop(drain=True)
 
     def generate(self, name: str, prompt, *, max_tokens: int = 16,
                  temperature: float = 0.0, stop=(), seed=None,
                  timeout: Optional[float] = None, ctx=None) -> Dict:
         self.registry.get(name)                     # -> 404 if unknown
-        sched = self._schedulers.get(name)
+        arm = self.registry.route_arm(name)
+        sched = self._schedulers.get((name, arm))
         if sched is None:
-            sched = self.enable_generation(name)
-        return sched.submit(prompt, max_tokens=max_tokens,
-                            temperature=temperature, stop=stop, seed=seed,
-                            timeout=timeout, ctx=ctx)
+            # canary decode traffic mirrors the stable scheduler's opts
+            sched = self.enable_generation(
+                name, arm=arm,
+                **(self._sched_opts.get(name, {}) if arm != "stable"
+                   else {}))
+        t0 = time.perf_counter()
+        try:
+            res = sched.submit(prompt, max_tokens=max_tokens,
+                               temperature=temperature, stop=stop,
+                               seed=seed, timeout=timeout, ctx=ctx)
+        except BaseException:
+            self._observe_arm(name, arm, time.perf_counter() - t0, ctx,
+                              error=True)
+            raise
+        self._observe_arm(name, arm, time.perf_counter() - t0, ctx,
+                          error=False)
+        return res
 
     def predict(self, name: str, features, batched: Optional[bool] = None,
                 ctx=None) -> Tuple[np.ndarray, int, str]:
         """(outputs, version, path) where path is 'batched' | 'direct'.
         Oversize requests (rows > largest bucket) always go direct — the
-        direct path chunks; the batcher never splits a request."""
+        direct path chunks; the batcher never splits a request. While a
+        canary is active, a deterministic fraction of requests serves on
+        the candidate arm, and every request's latency/error/SLO-breach
+        outcome feeds the canary's per-arm stats."""
         v = self.registry.get(name)                 # -> 404 if unknown
         try:
             x = _validate_features(v, features)
         except ServingError as e:
             raise ClientError(str(e)) from None
+        arm = self.registry.route_arm(name)
         use_batch = self.batching if batched is None else bool(batched)
+        t0 = time.perf_counter()
+        try:
+            out, version, path = self._predict_arm(name, x, arm,
+                                                   use_batch, ctx)
+        except BaseException:
+            self._observe_arm(name, arm, time.perf_counter() - t0, ctx,
+                              error=True)
+            raise
+        self._observe_arm(name, arm, time.perf_counter() - t0, ctx,
+                          error=False)
+        return out, version, path
+
+    def _predict_arm(self, name: str, x: np.ndarray, arm: str,
+                     use_batch: bool, ctx) -> Tuple[np.ndarray, int, str]:
         path, batcher = "direct", None
         if use_batch:
-            batcher = self._batcher(name)
+            batcher = self._batcher(name, arm)
             # route by the BATCHER's own row budget (it may be smaller
             # than the largest bucket, or stale after a bucket-changing
             # swap) — oversize requests go direct, which chunks, instead
@@ -224,15 +288,52 @@ class InferenceServer:
                 path = "batched"
         with self._latency.time(model=name, path=path):
             if path == "batched":
-                out, version = batcher.submit(x, ctx=ctx)
+                try:
+                    out, version = batcher.submit(x, ctx=ctx)
+                except BatcherClosedError:
+                    if arm == "canary" and not self._stopping:
+                        # the canary batcher was retired by a concurrent
+                        # promote/rollback — fall back to the stable arm
+                        # rather than fail an accepted request
+                        out, version = self._batcher(name).submit(x, ctx=ctx)
+                    else:
+                        raise
             else:
                 if ctx is not None:
                     with ctx.span("direct_forward", model=name,
-                                  rows=int(x.shape[0])):
-                        out, version = self.registry.predict(name, x)
+                                  rows=int(x.shape[0]), arm=arm):
+                        out, version = self.registry.predict(name, x,
+                                                             arm=arm)
                 else:
-                    out, version = self.registry.predict(name, x)
+                    out, version = self.registry.predict(name, x, arm=arm)
         return out, version, path
+
+    def _observe_arm(self, name: str, arm: str, dt: float, ctx,
+                     error: bool):
+        """Feed one request outcome into the live canary's per-arm stats
+        (latency, error, SLO breach against the request's tier target).
+        No-op when no canary is active."""
+        if self.registry.canary_state(name) is None:
+            return
+        tier = ctx.tier if ctx is not None else DEFAULT_TIER
+        target = self.slo.targets.get(tier)
+        self.registry.observe_canary(
+            name, arm, latency_s=dt, error=error,
+            breach=target is not None and dt > target)
+
+    def _retire_canary(self, name: str):
+        """Drain and drop the candidate arm's batcher/scheduler after a
+        promote or rollback. In-flight canary requests finish first (the
+        runner resolves through `arm_version`, which already falls back
+        to the post-decision version); requests racing the retirement
+        fall back to the stable batcher."""
+        with self._batchers_lock:
+            b = self._batchers.pop((name, "canary"), None)
+            s = self._schedulers.pop((name, "canary"), None)
+        if b is not None:
+            b.stop(drain=True)
+        if s is not None:
+            s.stop(drain=True)
 
     # -- HTTP plumbing ---------------------------------------------------
     def _make_handler(self):
@@ -369,6 +470,51 @@ class InferenceServer:
                                 f"invalid swap parameters: {e}") from None
                         self._reply(200, v.info(), endpoint=endpoint,
                                     model=model)
+                    elif m and m.group(2) == "canary" and method == "GET":
+                        endpoint, model = "canary", m.group(1)
+                        srv.registry.get(model)     # -> 404 if unknown
+                        cs = srv.registry.canary_state(model)
+                        payload = {"model": model, "active": cs is not None}
+                        if cs is not None:
+                            payload.update(cs.stats())
+                        self._reply(200, payload, endpoint=endpoint,
+                                    model=model)
+                    elif m and m.group(2) == "canary" and method == "POST":
+                        endpoint, model = "canary", m.group(1)
+                        body = parse_json_body(self)
+                        action = require(body, "action")
+                        if action == "start":
+                            try:
+                                v = srv.registry.start_canary(
+                                    model, require(body, "source"),
+                                    fraction=float(
+                                        body.get("fraction", 0.1)),
+                                    precision=body.get("precision"),
+                                    buckets=body.get("buckets"),
+                                    input_shape=body.get("input_shape"))
+                            except ClientError:
+                                raise
+                            except (TypeError, ValueError) as e:
+                                raise ClientError(
+                                    f"invalid canary parameters: {e}") \
+                                    from None
+                            self._reply(200, dict(v.info(), canary=True),
+                                        endpoint=endpoint, model=model)
+                        elif action == "promote":
+                            v = srv.registry.promote_canary(model)
+                            srv._retire_canary(model)
+                            self._reply(200, dict(v.info(), promoted=True),
+                                        endpoint=endpoint, model=model)
+                        elif action == "rollback":
+                            v = srv.registry.rollback_canary(model)
+                            srv._retire_canary(model)
+                            self._reply(200,
+                                        dict(v.info(), rolled_back=True),
+                                        endpoint=endpoint, model=model)
+                        else:
+                            raise ClientError(
+                                f"unknown canary action {action!r}; "
+                                "expected start|promote|rollback")
                     else:
                         self._reply(404, {"error": f"unknown path "
                                           f"{method} {self.path}"},

@@ -10,7 +10,11 @@ no external broker dependency, same capability surface:
 
   * `FileTopic` — segmented append-only log; records are length-prefixed
     blobs; logical offsets (record indices) like Kafka's; torn tails from
-    a crash are detected and truncated on open (Kafka log recovery).
+    a crash are detected on open and skipped by readers, then truncated
+    by the NEXT WRITER before it appends (Kafka log recovery on the
+    partition leader) — with a warning and a
+    `dl4j_topic_torn_records_total` counter, so a crashed producer never
+    poisons subsequent consumers.
   * `TopicPublisher` — `publish(array)` appends durably (fsync optional).
   * `TopicConsumer` — `take(timeout)` / `seek(offset)` / `commit()`;
     committed offsets persist per consumer GROUP (atomic file replace),
@@ -24,6 +28,7 @@ The serde is the module's `NDArraySerde` (.npy), so `TopicPublisher` /
 from __future__ import annotations
 
 import json
+import logging
 import os
 import struct
 import time
@@ -32,12 +37,24 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from . import NDArraySerde
+from ..telemetry.runtime import active as _tel_active
 
 __all__ = ["FileTopic", "TopicPublisher", "TopicConsumer"]
 
 _LEN = struct.Struct(">Q")
 _SEG_PREFIX = "segment_"
 _SEG_SUFFIX = ".log"
+
+_log = logging.getLogger(__name__)
+
+
+def _count_torn(topic: str, n: int = 1):
+    tel = _tel_active()
+    if tel is not None:
+        tel.registry.counter(
+            "dl4j_topic_torn_records_total",
+            "torn tail records truncated during topic log recovery",
+            labels=("topic",)).inc(n, topic=topic)
 
 
 class FileTopic:
@@ -71,7 +88,7 @@ class FileTopic:
         # path -> byte length the index covers; a mismatch with the file's
         # real size means another writer appended (or we crashed mid-write)
         self._indexed_bytes: dict = {}
-        self._recover()
+        self._reindex()   # read-only: opening a topic never truncates
 
     # -- log structure ---------------------------------------------------
     def _segments(self) -> List[Tuple[int, str]]:
@@ -103,21 +120,42 @@ class FileTopic:
                 pos += _LEN.size + ln
         return offs, pos
 
-    def _recover(self):
-        """Truncate a torn tail in the last segment (Kafka log recovery),
-        index it, and compute the end offset."""
+    def _reindex(self):
+        """Index the last segment up to its valid prefix and compute the
+        end offset. Read-only: a torn tail (partial record from a crashed
+        or in-flight producer) is simply ignored, NEVER truncated — a
+        reader must not destroy bytes a live writer may still be
+        appending. Returns (path, valid, size) for the last segment, or
+        None when the log is empty."""
         segs = self._segments()
         if not segs:
             self._end = 0
-            return
+            return None
         base, path = segs[-1]
         offs, valid = self._scan(path)
-        if valid < os.path.getsize(path):
-            with open(path, "r+b") as f:
-                f.truncate(valid)
         self._index[path] = offs
         self._indexed_bytes[path] = valid
         self._end = base + len(offs)
+        return path, valid, os.path.getsize(path)
+
+    def _recover(self):
+        """Writer-side log recovery (Kafka's analog runs on the partition
+        leader): truncate a torn tail in the last segment so the next
+        append lands on a record boundary. Only the append path calls
+        this — see `_reindex` for the reader contract."""
+        last = self._reindex()
+        if last is None:
+            return
+        path, valid, size = last
+        if valid < size:
+            with open(path, "r+b") as f:
+                f.truncate(valid)
+            _log.warning(
+                "topic %s: truncated torn tail record in %s "
+                "(%d bytes past last valid record at %d)",
+                os.path.basename(self.dir), os.path.basename(path),
+                size - valid, valid)
+            _count_torn(os.path.basename(self.dir))
 
     # -- producer side ---------------------------------------------------
     def append(self, payload: bytes) -> int:
@@ -166,7 +204,7 @@ class FileTopic:
     def read(self, offset: int) -> Optional[bytes]:
         """Record at logical `offset`, or None past the end."""
         if offset >= self._end:
-            self._recover()   # another process may have appended
+            self._reindex()   # another process may have appended
             if offset >= self._end:
                 return None
         segs = self._segments()

@@ -199,6 +199,33 @@ class TelemetrySession:
             out["snapshot_s"] = round(t.sum(), 4)
         return out
 
+    def continual_summary(self) -> Dict:
+        """Continual train-to-serve metrics (continual/): windows trained
+        by result, gate pass/fail, canary requests per arm, promotions +
+        promotion latency, rollbacks by reason. Empty dict when no
+        continual loop ran under this session."""
+        out: Dict = {}
+        for name, key in (("dl4j_continual_windows_total", "windows"),
+                          ("dl4j_continual_gate_total", "gate"),
+                          ("dl4j_continual_rollbacks_total", "rollbacks")):
+            c = self.registry.get(name)
+            if c is not None and c.values():
+                out[key] = {k[0]: int(v)
+                            for k, v in sorted(c.values().items())}
+        c = self.registry.get("dl4j_continual_canary_requests_total")
+        if c is not None and c.values():
+            arms: Dict = {}
+            for (model, arm), v in c.values().items():
+                arms[arm] = arms.get(arm, 0) + int(v)
+            out["canary_requests"] = dict(sorted(arms.items()))
+        c = self.registry.get("dl4j_continual_promotions_total")
+        if c is not None and c.values():
+            out["promotions"] = int(sum(c.values().values()))
+        t = self.registry.get("dl4j_continual_promotion_latency_seconds")
+        if t is not None and t.count():
+            out["promotion_latency_s"] = round(t.sum() / t.count(), 4)
+        return out
+
     def summary(self) -> Dict:
         """The compact dict bench.py embeds as extras.telemetry."""
         rep = self.compiles.report()
@@ -225,6 +252,9 @@ class TelemetrySession:
         elastic = self.elastic_summary()
         if elastic:
             out["elastic"] = elastic
+        continual = self.continual_summary()
+        if continual:
+            out["continual"] = continual
         return out
 
 

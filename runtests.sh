@@ -89,6 +89,16 @@
 #                            2-process kill/rejoin drills (slow marker;
 #                            capability-gated — they skip where the jax
 #                            CPU backend lacks multiprocess collectives)
+#   ./runtests.sh continual  online-learning smoke (ISSUE 20): the
+#                            continual train-to-serve suite (journal
+#                            crash consistency + the every-boundary
+#                            crash drill, eval gate, deterministic
+#                            canary routing, SLO auto-rollback with
+#                            zero failed stable requests, torn-topic-
+#                            record recovery, /canary HTTP endpoints)
+#                            plus one end-to-end loop rep: bootstrap ->
+#                            improvement window auto-promotes -> NaN
+#                            window auto-rolls-back, stable untouched
 #   ./runtests.sh lint       graftlint, both tiers: the AST pass
 #                            (jit/tracer hygiene, recompile hazards,
 #                            donation safety, concurrency lint) AND the
@@ -174,6 +184,13 @@ if [[ "${1:-}" == "elastic" ]]; then
     echo "=== real 2-process kill/rejoin drills (capability-gated) ==="
     exec python -m pytest tests/test_multiprocess_distributed.py -q \
         -k elastic
+fi
+if [[ "${1:-}" == "continual" ]]; then
+    echo "=== continual train-to-serve smoke ==="
+    python -m pytest tests/test_continual.py -q
+    echo "=== end-to-end loop rep (promote then rollback) ==="
+    exec env JAX_PLATFORMS=cpu \
+        python -m deeplearning4j_tpu.continual.trainer
 fi
 if [[ "${1:-}" == "fault" ]]; then
     echo "=== fault-tolerance smoke ==="

@@ -1,0 +1,507 @@
+"""Continual train-to-serve plane (ISSUE 20): journal crash consistency,
+eval-gated canary promotion/rollback, deterministic canary routing, the
+torn-topic-record regression, and the crash drill that kills the loop at
+every journal boundary and proves recovery never serves an ungated
+candidate, never replays a trained window, and never skips one."""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer, Sgd,
+                                ModelSerializer)
+from deeplearning4j_tpu.continual import (CanaryPolicy, ContinualJournal,
+                                          ContinualTrainer,
+                                          JournalCorruptError)
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.datasets.pipeline import split_xy
+from deeplearning4j_tpu.fault.injection import SimulatedCrash, crash_at_write
+from deeplearning4j_tpu.serving import (AotCompileError, InferenceServer,
+                                        ModelRegistry, ServingError)
+from deeplearning4j_tpu.streaming.topic import FileTopic, TopicPublisher
+from deeplearning4j_tpu.telemetry import runtime as tel_runtime
+
+# graftlint runtime sanitizer: the trainer itself is single-threaded
+# (canary traffic is pumped by the test via traffic_hook), so any thread
+# alive at teardown is a leaked batcher/HTTP worker.
+pytestmark = pytest.mark.sanitize
+
+N_IN, N_OUT = 6, 3
+_W_TRUE = np.random.default_rng(11).normal(
+    size=(N_IN, N_OUT)).astype(np.float32)
+
+
+def tiny_net(seed=0, hidden=8):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def batch(n, seed):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[(x @ _W_TRUE).argmax(1)]
+    return x, y
+
+
+def publish_window(pub, n=8, seed=0, poison=False):
+    x, y = batch(n, seed)
+    if poison:
+        x[:] = np.nan
+    return pub.publish(np.concatenate([x, y], axis=1))
+
+
+def gate_set(seed=99, n=48):
+    gx, gy = batch(n, seed)
+    return DataSet(gx, gy)
+
+
+def pump_canary(reg, name, n=4, latency=0.001, breach=False, error=False):
+    for _ in range(n):
+        reg.observe_canary(name, "canary", latency_s=latency,
+                           breach=breach, error=error)
+
+
+# ---------------------------------------------------------------------------
+# ContinualJournal
+# ---------------------------------------------------------------------------
+def test_journal_append_replay_roundtrip(tmp_path):
+    j = ContinualJournal(str(tmp_path / "j.jsonl"))
+    j.append("promoted", cycle=0, ckpt="a.zip", offset=0, score=None)
+    j.append("window", cycle=1, start=0, end=2, batches=4, skipped=0,
+             nonfinite=0)
+    recs = j.replay()
+    assert [r["kind"] for r in recs] == ["promoted", "window"]
+    assert recs[1]["end"] == 2 and "ts" in recs[0]
+
+
+def test_journal_torn_tail_dropped_committed_garbage_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = ContinualJournal(path)
+    j.append("promoted", cycle=0, ckpt="a.zip", offset=0, score=1.0)
+    # a crash mid-append leaves a partial line with no newline: replay
+    # must drop it (the transition never committed), not raise
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "window", "cy')
+    recs = j.replay()
+    assert len(recs) == 1 and recs[0]["kind"] == "promoted"
+    # a NEWLINE-TERMINATED garbage line can't be a torn append — that's
+    # real corruption and replay must refuse to guess
+    with open(path, "ab") as f:
+        f.write(b'not json at all\n')
+    with pytest.raises(JournalCorruptError):
+        j.replay()
+
+
+def test_journal_newline_in_value_stays_single_line(tmp_path):
+    # json escaping keeps every record one physical line, so a newline
+    # inside a field value can't forge a phantom record boundary
+    path = str(tmp_path / "j.jsonl")
+    j = ContinualJournal(path)
+    j.append("rolled_back", cycle=1, reason="multi\nline\ndetail")
+    with open(path, "rb") as f:
+        assert f.read().count(b"\n") == 1
+    recs = j.replay()
+    assert recs[0]["reason"] == "multi\nline\ndetail"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: torn topic tail — readers never truncate, the writer does
+# (with a warning and the dl4j_topic_torn_records_total counter)
+# ---------------------------------------------------------------------------
+def test_topic_torn_tail_reader_preserves_writer_truncates(tmp_path, caplog):
+    topic = FileTopic(str(tmp_path), "events")
+    pub = TopicPublisher(topic)
+    a0 = pub.publish(np.arange(4, dtype=np.float32))
+    a1 = pub.publish(np.arange(8, dtype=np.float32))
+    seg = [p for _, p in topic._segments()][-1]
+    # simulate a producer crash mid-append: a length header promising
+    # more bytes than were written
+    import struct
+    with open(seg, "ab") as f:
+        f.write(struct.pack(">Q", 1 << 20) + b"partial")
+    torn_size = os.path.getsize(seg)
+
+    # a fresh READER indexes past both records, ignores the torn tail,
+    # and must NOT touch the file (the bytes may belong to a live writer)
+    reader = FileTopic(str(tmp_path), "events")
+    assert reader.read(a1) is not None and reader.end_offset() == 2
+    assert os.path.getsize(seg) == torn_size
+
+    # the WRITER truncates on its next append — warning + counter
+    with tel_runtime.enabled() as tel:
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.streaming.topic"):
+            a2 = pub.publish(np.arange(2, dtype=np.float32))
+        assert any("torn tail" in r.message for r in caplog.records)
+        assert tel.registry.counter(
+            "dl4j_topic_torn_records_total",
+            labels=("topic",)).value(topic="events") == 1.0
+    assert a2 == 2
+    # every record is intact after recovery
+    for off, n in ((a0, 4), (a1, 8), (a2, 2)):
+        assert reader.read(off) is not None
+    assert reader.end_offset() == 3
+
+
+# ---------------------------------------------------------------------------
+# CanaryPolicy decision table
+# ---------------------------------------------------------------------------
+def _stats(c_req=0, c_err=0, c_breach=0, c_lat=0.001, s_req=0, s_breach=0,
+           s_lat=0.001):
+    return {"arms": {
+        "canary": {"requests": c_req, "errors": c_err, "breaches": c_breach,
+                   "latency_mean": c_lat},
+        "stable": {"requests": s_req, "errors": 0, "breaches": s_breach,
+                   "latency_mean": s_lat}}}
+
+
+def test_canary_policy_decisions():
+    p = CanaryPolicy(min_requests=10, max_error_rate=0.0,
+                     max_breach_rate=0.25, max_latency_ratio=3.0,
+                     max_score_drift=0.5)
+    assert p.decide(_stats(c_req=9)) is None          # not enough traffic
+    assert p.decide(_stats(c_req=10, s_req=10)) == ("promote", None)
+    assert p.decide(_stats(c_req=10, c_err=1)) == ("rollback", "errors")
+    # breaches roll back only when the canary is worse than stable — a
+    # global slowdown hitting both arms is not the candidate's fault
+    assert p.decide(_stats(c_req=10, c_breach=5, s_req=10,
+                           s_breach=6)) == ("promote", None)
+    assert p.decide(_stats(c_req=10, c_breach=5,
+                           s_req=10)) == ("rollback", "slo_breach")
+    assert p.decide(_stats(c_req=10, s_req=10, c_lat=0.01,
+                           s_lat=0.001)) == ("rollback", "latency")
+    # score drift decides even before min_requests
+    assert p.decide(_stats(c_req=0),
+                    score_drift=0.6) == ("rollback", "score_drift")
+
+
+# ---------------------------------------------------------------------------
+# Registry canary mechanics
+# ---------------------------------------------------------------------------
+def test_canary_routing_deterministic_fraction():
+    reg = ModelRegistry(buckets=(1, 4))
+    reg.register("m", tiny_net(0))
+    assert reg.route_arm("m") == "stable"       # no canary -> all stable
+    reg.start_canary("m", tiny_net(1), fraction=0.2)
+    arms = [reg.route_arm("m") for _ in range(200)]
+    assert arms.count("canary") == 40           # exactly 20% of each 100
+    # and the slice is deterministic, not sampled
+    assert arms[:100] == arms[100:]
+    reg.rollback_canary("m")
+
+
+def test_same_arch_canary_zero_new_compiles_and_register_blocked():
+    reg = ModelRegistry(buckets=(1, 4))
+    reg.register("m", tiny_net(0))
+    compiles = reg.metrics.counter("dl4j_serving_compiles_total",
+                                   labels=("model", "bucket"))
+    before = sum(compiles.values().values())
+    cand = reg.start_canary("m", tiny_net(5), fraction=0.5)
+    assert sum(compiles.values().values()) == before, \
+        "same-architecture canary must reuse the shared executable cache"
+    assert cand.version == reg.get("m").version + 1
+    with pytest.raises(ServingError, match="canary"):
+        reg.register("m", tiny_net(6))          # no swaps under a canary
+    reg.rollback_canary("m")
+
+
+def test_promote_flips_rollback_bit_exact_versions_monotonic():
+    reg = ModelRegistry(buckets=(1, 4))
+    v1 = reg.register("m", tiny_net(0))
+    x = batch(3, seed=42)[0]
+    stable_out, ver = reg.predict("m", x)
+    assert ver == v1.version
+
+    # rollback: stable version object and outputs are bit-identical
+    cand = reg.start_canary("m", tiny_net(1), fraction=0.1)
+    cand_out, cver = reg.predict("m", x, arm="canary")
+    assert cver == cand.version and not np.array_equal(cand_out, stable_out)
+    reg.rollback_canary("m")
+    assert reg.get("m") is v1
+    out2, _ = reg.predict("m", x)
+    np.testing.assert_array_equal(out2, stable_out)
+
+    # promote: the candidate becomes current; version numbers are never
+    # reused even across the rolled-back candidate
+    cand2 = reg.start_canary("m", tiny_net(2), fraction=0.1)
+    assert cand2.version > cand.version
+    promoted = reg.promote_canary("m")
+    assert reg.get("m") is promoted and promoted.version == cand2.version
+    assert reg.canary_state("m") is None
+
+
+def test_arm_version_falls_back_to_stable():
+    reg = ModelRegistry(buckets=(1,))
+    v1 = reg.register("m", tiny_net(0))
+    # a request routed to "canary" just before rollback still gets a
+    # servable version, never an error
+    assert reg.arm_version("m", "canary") is v1
+    reg.observe_canary("m", "canary")           # no-op without a canary
+
+
+# ---------------------------------------------------------------------------
+# ContinualTrainer end-to-end
+# ---------------------------------------------------------------------------
+def _mk_trainer(reg, topic, workdir, **kw):
+    opts = dict(workdir=str(workdir), gate_set=gate_set(),
+                initial_source=tiny_net(1), feature_width=N_IN,
+                window_records=1, batch_size=8, gate_margin=1.0,
+                canary_fraction=0.3,
+                canary_policy=CanaryPolicy(min_requests=4),
+                canary_timeout_s=10.0, canary_poll_s=0.001,
+                buckets=(1, 8), fsync_journal=False)
+    opts.update(kw)
+    return ContinualTrainer(reg, "m", topic, **opts)
+
+
+def test_trainer_promotes_improvement_then_rolls_back_poison(tmp_path):
+    topic = FileTopic(str(tmp_path), "t")
+    pub = TopicPublisher(topic)
+    reg = ModelRegistry(buckets=(1, 8))
+    t = _mk_trainer(reg, topic, tmp_path / "loop",
+                    traffic_hook=lambda: pump_canary(reg, "m"))
+    v1 = t.recover()
+    assert reg.get("m").version == v1.version
+
+    publish_window(pub, seed=1)
+    res = t.run_cycle()
+    assert res["outcome"] == "promoted" and res["version"] > v1.version
+    assert reg.get("m").version == res["version"]
+    assert topic.committed("continual") == 1
+
+    # a poisoned window under guard_policy=skip_batch trains nothing:
+    # the cycle rolls back as empty_window without wasting a gate/canary
+    publish_window(pub, seed=2, poison=True)
+    res2 = t.run_cycle()
+    assert res2 == {"cycle": res["cycle"] + 1, "outcome": "rolled_back",
+                    "reason": "empty_window"}
+    assert reg.get("m").version == res["version"]
+    assert topic.committed("continual") == 2    # poison never replays
+    # candidate checkpoints of discarded cycles are reclaimed
+    assert not os.path.exists(tmp_path / "loop" / f"cand_{res2['cycle']:05d}.zip")
+    assert t.run_cycle() is None                # topic drained
+
+
+def test_trainer_gate_rejects_unguarded_nan(tmp_path):
+    topic = FileTopic(str(tmp_path), "t")
+    pub = TopicPublisher(topic)
+    reg = ModelRegistry(buckets=(1, 8))
+    t = _mk_trainer(reg, topic, tmp_path / "loop", guard_policy=None)
+    t.recover()
+    stable = reg.get("m")
+    publish_window(pub, seed=3, poison=True)
+    res = t.run_cycle()
+    assert res["outcome"] == "rolled_back" and res["reason"] == "gate_fail"
+    assert reg.get("m") is stable
+
+
+def test_canary_slo_regression_auto_rollback_zero_stable_failures(tmp_path):
+    """The acceptance drill: an injected latency regression on the canary
+    arm rolls the candidate back automatically while the stable arm
+    serves every request — zero failures, outputs bit-exact before,
+    during, and after the canary."""
+    topic = FileTopic(str(tmp_path), "t")
+    pub = TopicPublisher(topic)
+    with tel_runtime.enabled() as tel:
+        reg = ModelRegistry(buckets=(1, 8), metrics=tel.registry)
+        srv = InferenceServer(reg, batching=True, max_wait_us=500)
+        x = batch(2, seed=7)[0]
+        failures = []
+        served = []
+
+        def traffic():
+            # canary arm: synthetically slow + SLO-breaching
+            pump_canary(reg, "m", n=8, latency=10.0, breach=True)
+            # live traffic through the server — 40 requests spans the
+            # canary slice (first 30 of each 100) AND the stable remainder
+            for _ in range(40):
+                try:
+                    out, version, _ = srv.predict("m", x)
+                    served.append((np.asarray(out), version))
+                except Exception as e:  # noqa: BLE001 - drill bookkeeping
+                    failures.append(repr(e))
+
+        t = _mk_trainer(reg, topic, tmp_path / "loop",
+                        canary_policy=CanaryPolicy(min_requests=32,
+                                                   max_breach_rate=0.1),
+                        traffic_hook=traffic)
+        v_stable = t.recover().version
+        baseline, _, _ = srv.predict("m", x)
+        publish_window(pub, seed=4)
+        res = t.run_cycle()
+        after, _, _ = srv.predict("m", x)
+        srv.stop()
+
+    assert res["outcome"] == "rolled_back" and res["reason"] == "slo_breach"
+    assert failures == []               # NOT ONE request failed
+    stable_outs = [out for out, v in served if v == v_stable]
+    assert stable_outs                  # the stable arm did serve traffic
+    for out in stable_outs + [np.asarray(after)]:
+        np.testing.assert_array_equal(out, np.asarray(baseline))
+    summary = tel.summary()["continual"]
+    assert summary["rollbacks"] == {"slo_breach": 1}
+    assert summary["canary_requests"]["canary"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# The crash drill: kill the loop at EVERY journal boundary
+# ---------------------------------------------------------------------------
+CRASH_POINTS = [
+    "continual/stable_registered",
+    "continual/window_consumed",
+    "continual/window_trained",
+    "continual/candidate_saved",
+    "continual/window_record",
+    "continual/offset_committed",
+    "continual/gate_record",
+    "continual/canary_started",
+    "continual/decision_record",
+    "continual/decision_applied",
+]
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_drill_recovery_is_consistent(tmp_path, point):
+    """Kill the loop at `point`; a fresh trainer + registry over the same
+    workdir must (a) serve exactly the journal's last committed promoted
+    checkpoint bit-exact, (b) never serve the undecided candidate, and
+    (c) neither replay nor skip any window: after draining, the journaled
+    windows tile [0, total_published) exactly once."""
+    topic = FileTopic(str(tmp_path), "t")
+    pub = TopicPublisher(topic)
+    for seed in (1, 2):
+        publish_window(pub, seed=seed)
+
+    def mk(reg):
+        return _mk_trainer(reg, topic, tmp_path / "loop",
+                           gate_margin=100.0,   # gate passes: every cycle
+                                                # reaches the canary points
+                           traffic_hook=lambda: pump_canary(reg, "m"))
+
+    reg1 = ModelRegistry(buckets=(1, 8))
+    with pytest.raises(SimulatedCrash):
+        with crash_at_write(point):
+            t1 = mk(reg1)
+            t1.recover()
+            t1.run(max_cycles=4, poll_timeout_s=0)
+
+    journal = ContinualJournal(str(tmp_path / "loop" / "journal.jsonl"))
+    pre = journal.replay()
+    promoted = [r for r in pre if r["kind"] == "promoted"][-1]
+    expect = ModelRegistry(buckets=(1, 8))
+    expect.register("m", ModelSerializer.restore(promoted["ckpt"]))
+    x = batch(3, seed=77)[0]
+    want = expect.predict("m", x)[0]
+
+    reg2 = ModelRegistry(buckets=(1, 8))
+    t2 = mk(reg2)
+    t2.recover()
+    # (a)+(b): exactly the pre-crash committed version, bit-exact — an
+    # undecided candidate was closed out as rolled_back, never served
+    got = reg2.predict("m", x)[0]
+    np.testing.assert_array_equal(got, np.asarray(want))
+    assert reg2.canary_state("m") is None
+    post = journal.replay()
+    open_kinds = {"window", "gate", "canary"}
+    if pre and pre[-1]["kind"] in open_kinds:
+        assert post[len(pre)]["kind"] == "rolled_back"
+        assert post[len(pre)]["reason"] == "crash_recovery"
+
+    # (c): drain and check the trained windows tile the topic exactly
+    t2.run(max_cycles=8, poll_timeout_s=0)
+    spans = sorted((r["start"], r["end"]) for r in journal.replay()
+                   if r["kind"] == "window")
+    assert spans[0][0] == 0 and spans[-1][1] == 2
+    for (_, e1), (s2, _) in zip(spans, spans[1:]):
+        assert s2 == e1, f"window replayed or skipped: {spans}"
+    assert topic.committed("continual") == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP canary endpoints
+# ---------------------------------------------------------------------------
+def test_http_canary_endpoints(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    def http(method, url, body=None):
+        req = urllib.request.Request(
+            url, None if body is None else json.dumps(body).encode(),
+            {"Content-Type": "application/json"}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    reg = ModelRegistry(buckets=(1, 4))
+    v1 = reg.register("m", tiny_net(0))
+    ckpt = str(tmp_path / "cand.zip")
+    ModelSerializer.write_model(tiny_net(3), ckpt)
+    srv = InferenceServer(reg, max_wait_us=500).start()
+    try:
+        base = f"http://{srv.host}:{srv.port}/v1/models/m/canary"
+        code, out = http("GET", base)
+        assert code == 200 and out == {"model": "m", "active": False}
+
+        code, out = http("POST", base, {"action": "start", "source": ckpt,
+                                        "fraction": 0.5})
+        assert code == 200 and out["canary"] is True
+        cand_version = out["version"]
+        code, out = http("GET", base)
+        assert code == 200 and out["active"] is True
+        assert out["version"] == cand_version and out["fraction"] == 0.5
+        # double-start is a client error, live canary untouched
+        code, _ = http("POST", base, {"action": "start", "source": ckpt})
+        assert code == 400
+
+        code, out = http("POST", base, {"action": "promote"})
+        assert code == 200 and out["promoted"] is True
+        assert out["version"] == cand_version
+        assert reg.get("m").version == cand_version
+
+        code, out = http("POST", base, {"action": "start", "source": ckpt})
+        assert code == 200
+        code, out = http("POST", base, {"action": "rollback"})
+        assert code == 200 and out["rolled_back"] is True
+        assert out["version"] == cand_version
+
+        code, out = http("POST", base, {"action": "resize"})
+        assert code == 400 and "unknown canary action" in out["error"]
+        code, _ = http("GET", f"http://{srv.host}:{srv.port}"
+                              "/v1/models/nope/canary")
+        assert code == 404
+    finally:
+        srv.stop()
+    assert v1.version < cand_version
+
+
+def test_trainer_requires_recover_and_decoder(tmp_path):
+    topic = FileTopic(str(tmp_path), "t")
+    reg = ModelRegistry(buckets=(1, 8))
+    with pytest.raises(ValueError, match="feature_width"):
+        ContinualTrainer(reg, "m", topic, workdir=str(tmp_path / "w"),
+                         gate_set=gate_set())
+    t = _mk_trainer(reg, topic, tmp_path / "loop")
+    with pytest.raises(RuntimeError, match="recover"):
+        t.run_cycle()
+
+
+def test_split_xy_shapes_and_validation():
+    x, y = batch(5, seed=0)
+    rec = np.concatenate([x, y], axis=1)
+    fx, fy = split_xy(rec, N_IN)
+    np.testing.assert_array_equal(fx, x)
+    np.testing.assert_array_equal(fy, y)
+    fx1, fy1 = split_xy(rec[0], N_IN)            # 1-D record -> one row
+    assert fx1.shape == (1, N_IN) and fy1.shape == (1, N_OUT)
+    with pytest.raises(ValueError):
+        split_xy(rec, rec.shape[1])              # no label columns left

@@ -193,6 +193,51 @@ def test_swap_bumps_version_and_reuses_executables():
         assert reg.ensure("m", net_a).version == 2
 
 
+def test_swap_compile_failure_rejected_live_version_untouched():
+    """ISSUE 20 regression: a candidate whose AOT compile fails must be
+    rejected with a structured AotCompileError that leaves the live
+    version AND the shared executable cache bit-for-bit untouched — a bad
+    checkpoint cannot take down serving."""
+    from deeplearning4j_tpu.serving import AotCompileError
+
+    reg = ModelRegistry(buckets=(1, 4))
+    net = tiny_net(seed=1)
+    v1 = reg.register("m", net)
+    x = rows(2, seed=5)
+    expected, _ = reg.predict("m", x)
+    entry = reg._entries["m"]
+    cache_before = dict(entry.compiled)
+    compiles = reg.metrics.counter("dl4j_serving_compiles_total",
+                                   labels=("model", "bucket"))
+    n_compiles = sum(compiles.values().values())
+
+    # different architecture -> cache miss -> the poisoned forward is
+    # actually traced (a same-arch candidate would reuse executables and
+    # never hit the compiler)
+    bad = tiny_net(seed=2, hidden=8)
+
+    def boom(*args, **kw):
+        raise ValueError("injected trace failure")
+
+    bad.predict_fn = boom
+    with pytest.raises(AotCompileError) as ei:
+        reg.swap("m", bad)
+    err = ei.value
+    assert err.model == "m" and isinstance(err.cause, ValueError)
+    assert "injected trace failure" in str(err)
+
+    # live version, outputs, executable cache, compile accounting: all
+    # exactly as before the failed swap
+    assert reg.get("m") is v1
+    out, version = reg.predict("m", x)
+    assert version == v1.version
+    np.testing.assert_array_equal(out, expected)
+    assert entry.compiled == cache_before
+    assert sum(compiles.values().values()) == n_compiles
+    # and the registry still accepts a GOOD swap afterwards
+    assert reg.swap("m", tiny_net(seed=3)).version == v1.version + 1
+
+
 def test_compile_counter_metric_exported():
     with telemetry.enabled() as sess:
         reg = ModelRegistry(buckets=(2,), metrics=sess.registry)
